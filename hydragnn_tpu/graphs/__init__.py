@@ -1,0 +1,3 @@
+from .batch import GraphBatch
+from .sample import GraphSample
+from .collate import collate_graphs, compute_pad_sizes, unpack_targets, round_up_pow2

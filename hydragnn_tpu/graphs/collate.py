@@ -1,0 +1,153 @@
+"""Host-side collator: list[GraphSample] → padded GraphBatch numpy arrays.
+
+Replaces torch_geometric's DataLoader collation (reference:
+/root/reference/hydragnn/preprocess/load_data.py:53-86) with static-shape padding so
+XLA compiles once per (N_pad, E_pad, G_pad) bucket. Also replaces the per-batch
+``get_head_indices`` index math (/root/reference/hydragnn/train/train_validate_test.py:177-205):
+targets are unpacked from the packed y/y_loc layout into dense per-head arrays here,
+on the host, once per batch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batch import GraphBatch
+from .sample import GraphSample
+
+
+def round_up_pow2(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (≥ minimum) to bound XLA recompiles."""
+    v = max(int(n), minimum)
+    return 1 << (v - 1).bit_length()
+
+
+def unpack_targets(
+    sample: GraphSample, head_types: Sequence[str], head_dims: Sequence[int]
+) -> List[np.ndarray]:
+    """Split a packed ``y`` (offsets in ``y_loc``) into per-head dense arrays:
+    graph head → [dim]; node head → [n, dim] (row-major per node, matching the
+    reshape(-1, 1) packing at serialized_dataset_loader.py:246-256)."""
+    out = []
+    y = np.asarray(sample.y).reshape(-1)
+    y_loc = np.asarray(sample.y_loc).reshape(-1)
+    n = sample.num_nodes
+    for ihead, (htype, hdim) in enumerate(zip(head_types, head_dims)):
+        sl = y[int(y_loc[ihead]) : int(y_loc[ihead + 1])]
+        if htype == "graph":
+            out.append(sl.reshape(hdim))
+        elif htype == "node":
+            out.append(sl.reshape(n, hdim))
+        else:
+            raise ValueError(f"Unknown head type {htype}")
+    return out
+
+
+def collate_graphs(
+    graphs: Sequence[GraphSample],
+    head_types: Sequence[str] = (),
+    head_dims: Sequence[int] = (),
+    num_nodes_pad: Optional[int] = None,
+    num_edges_pad: Optional[int] = None,
+    num_graphs_pad: Optional[int] = None,
+    edge_dim: Optional[int] = None,
+) -> GraphBatch:
+    """Pack graphs into one padded GraphBatch (numpy arrays, host-side).
+
+    Always reserves ≥1 padding node and ≥1 padding graph; padding edges connect
+    padding nodes so unmasked message passing cannot touch real rows.
+    """
+    g = len(graphs)
+    tot_nodes = sum(s.num_nodes for s in graphs)
+    tot_edges = sum(s.num_edges for s in graphs)
+
+    n_pad = num_nodes_pad if num_nodes_pad is not None else round_up_pow2(tot_nodes + 1)
+    e_pad = num_edges_pad if num_edges_pad is not None else round_up_pow2(tot_edges + 1)
+    g_pad = num_graphs_pad if num_graphs_pad is not None else g + 1
+    if n_pad <= tot_nodes:
+        raise ValueError(f"num_nodes_pad={n_pad} must exceed total nodes {tot_nodes}")
+    if e_pad < tot_edges:
+        raise ValueError(f"num_edges_pad={e_pad} must fit total edges {tot_edges}")
+    if g_pad <= g:
+        raise ValueError(f"num_graphs_pad={g_pad} must exceed num graphs {g}")
+
+    feat_dim = graphs[0].x.shape[1]
+    node_features = np.zeros((n_pad, feat_dim), dtype=np.float32)
+    # Padding edges point at the last (always-padding) node.
+    senders = np.full((e_pad,), n_pad - 1, dtype=np.int32)
+    receivers = np.full((e_pad,), n_pad - 1, dtype=np.int32)
+    # Padding nodes belong to the last (always-padding) graph slot.
+    node_graph = np.full((n_pad,), g_pad - 1, dtype=np.int32)
+    node_mask = np.zeros((n_pad,), dtype=bool)
+    edge_mask = np.zeros((e_pad,), dtype=bool)
+    graph_mask = np.zeros((g_pad,), dtype=bool)
+    graph_mask[:g] = True
+
+    if edge_dim is None:
+        has_edge_attr = graphs[0].edge_attr is not None
+        edge_dim_eff = graphs[0].edge_attr.shape[1] if has_edge_attr else 0
+    else:
+        has_edge_attr = edge_dim > 0
+        edge_dim_eff = edge_dim
+    edge_features = (
+        np.zeros((e_pad, edge_dim_eff), dtype=np.float32) if has_edge_attr else None
+    )
+
+    targets = [
+        np.zeros(
+            (g_pad, hdim) if htype == "graph" else (n_pad, hdim), dtype=np.float32
+        )
+        for htype, hdim in zip(head_types, head_dims)
+    ]
+
+    node_off = 0
+    edge_off = 0
+    for gi, s in enumerate(graphs):
+        n = s.num_nodes
+        e = s.num_edges
+        node_features[node_off : node_off + n] = s.x
+        node_graph[node_off : node_off + n] = gi
+        node_mask[node_off : node_off + n] = True
+        if e:
+            senders[edge_off : edge_off + e] = s.edge_index[0] + node_off
+            receivers[edge_off : edge_off + e] = s.edge_index[1] + node_off
+            edge_mask[edge_off : edge_off + e] = True
+            if edge_features is not None and s.edge_attr is not None:
+                edge_features[edge_off : edge_off + e] = s.edge_attr[:, :edge_dim_eff]
+        if head_types:
+            per_head = unpack_targets(s, head_types, head_dims)
+            for ih, (htype, tval) in enumerate(zip(head_types, per_head)):
+                if htype == "graph":
+                    targets[ih][gi] = tval
+                else:
+                    targets[ih][node_off : node_off + n] = tval
+        node_off += n
+        edge_off += e
+
+    return GraphBatch(
+        node_features=node_features,
+        edge_features=edge_features,
+        senders=senders,
+        receivers=receivers,
+        node_graph=node_graph,
+        node_mask=node_mask,
+        edge_mask=edge_mask,
+        graph_mask=graph_mask,
+        targets=tuple(targets),
+        num_graphs_pad=g_pad,
+    )
+
+
+def compute_pad_sizes(
+    graphs: Sequence[GraphSample], batch_size: int
+) -> Tuple[int, int, int]:
+    """Dataset-level static pad sizes so every batch of ``batch_size`` graphs from
+    this dataset fits one compiled shape: a worst-case batch is the ``batch_size``
+    largest graphs."""
+    nodes = sorted((s.num_nodes for s in graphs), reverse=True)[:batch_size]
+    edges = sorted((s.num_edges for s in graphs), reverse=True)[:batch_size]
+    n_pad = round_up_pow2(sum(nodes) + 1)
+    e_pad = round_up_pow2(max(sum(edges), 1) + 1)
+    return n_pad, e_pad, batch_size + 1

@@ -1,0 +1,3 @@
+from .base import HydraGNN, MLPNode
+from .create import create_model, create_model_config, init_model_variables
+from .loss import multihead_rmse_loss, normalize_task_weights

@@ -1,0 +1,312 @@
+"""HydraGNN multi-headed GNN — the flax re-design of the reference architecture
+core (/root/reference/hydragnn/models/Base.py:20-372 plus the per-conv Stack
+subclasses). One module covers all six conv families; the conv flavor is a static
+field, so each (conv_type, dims) combination compiles to one XLA program.
+
+Architecture (mirrors reference semantics under padding):
+  encoder:   num_conv_layers × [conv → MaskedBatchNorm → ReLU]
+  readout:   masked segment-mean over nodes per graph (global_mean_pool analog)
+  heads:     graph heads = shared MLP ("graph_shared") + per-head MLP;
+             node heads = shared MLPNode ('mlp' / 'mlp_per_node') or a conv chain
+             ('conv'), exactly the reference's three node-head modes
+             (Base._multihead, Base.py:152-223).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..graphs.batch import GraphBatch
+from ..ops import segment as seg
+from .layers import MLP, MaskedBatchNorm
+from .convs import CGConv, GATv2Conv, GINConv, MFCConv, PNAConv, SAGEConv
+
+CONV_TYPES = ("PNA", "MFC", "GIN", "GAT", "CGCNN", "SAGE")
+
+
+class MLPNode(nn.Module):
+    """Node-level decoder head (reference MLPNode, Base.py:321-372).
+
+    'mlp': one MLP shared across nodes. 'mlp_per_node': a distinct MLP per node
+    slot — only valid for fixed-size graphs; implemented as degree-style weight
+    gather over the node's position inside its graph rather than the reference's
+    python loop over node indices."""
+
+    hidden_dims: Tuple[int, ...]
+    out_dim: int
+    node_type: str  # 'mlp' | 'mlp_per_node'
+    num_nodes: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, batch: GraphBatch) -> jnp.ndarray:
+        dims = tuple(self.hidden_dims) + (self.out_dim,)
+        if self.node_type == "mlp":
+            return MLP(dims, name="mlp")(x)
+        assert self.num_nodes is not None, "mlp_per_node requires fixed graph size"
+        n, f = x.shape
+        # Node position within its graph: nodes are contiguous per graph by
+        # collation, so pos = arange - start_of_my_graph.
+        counts = seg.segment_count(batch.node_graph, batch.num_graphs_pad)
+        starts = jnp.concatenate([jnp.zeros(1), jnp.cumsum(counts)[:-1]])
+        pos = (jnp.arange(n) - starts[batch.node_graph]).astype(jnp.int32)
+        pos = jnp.clip(pos, 0, self.num_nodes - 1)
+        h = x
+        in_dim = f
+        for li, d in enumerate(dims):
+            w = self.param(
+                f"w_{li}", nn.initializers.lecun_normal(), (self.num_nodes, in_dim, d)
+            )
+            b = self.param(f"b_{li}", nn.initializers.zeros, (self.num_nodes, d))
+            h = jnp.einsum("nf,nfo->no", h, w[pos]) + b[pos]
+            if li < len(dims) - 1:
+                h = nn.relu(h)
+            in_dim = d
+        return h
+
+
+class HydraGNN(nn.Module):
+    """Static configuration mirrors create_model's signature
+    (/root/reference/hydragnn/models/create.py:55-178)."""
+
+    conv_type: str
+    input_dim: int
+    hidden_dim: int
+    output_dim: Tuple[int, ...]
+    output_type: Tuple[str, ...]
+    config_heads: Dict[str, Any]
+    num_conv_layers: int
+    task_weights: Tuple[float, ...] = ()  # normalized to Σ|w|=1 (Base.py:74-75)
+    freeze_conv: bool = False
+    dropout: float = 0.25
+    num_nodes: Optional[int] = None
+    initial_bias: Optional[float] = None
+    ilossweights_nll: int = 0
+    # Conv-family-specific static parameters.
+    edge_dim: Optional[int] = None
+    pna_deg_avg_log: float = 1.0
+    pna_deg_avg_lin: float = 1.0
+    mfc_max_degree: int = 10
+    gat_heads: int = 6  # create.py:113
+    gat_negative_slope: float = 0.05  # create.py:114
+
+    @property
+    def use_edge_attr(self) -> bool:
+        return self.edge_dim is not None and self.edge_dim > 0
+
+    @property
+    def enc_dim(self) -> int:
+        """Width of the encoder output (hidden_dim except CGCNN, which preserves
+        channels — CGCNNStack.py:31-42)."""
+        return self.input_dim if self.conv_type == "CGCNN" else self.hidden_dim
+
+    def _make_conv(self, in_dim: int, out_dim: int, name: str, concat: bool = True):
+        ct = self.conv_type
+        if ct == "SAGE":
+            return SAGEConv(out_dim, name=name)
+        if ct == "GIN":
+            return GINConv(out_dim, name=name)
+        if ct == "MFC":
+            return MFCConv(out_dim, self.mfc_max_degree, name=name)
+        if ct == "GAT":
+            return GATv2Conv(
+                out_dim,
+                heads=self.gat_heads,
+                negative_slope=self.gat_negative_slope,
+                concat=concat,
+                dropout=self.dropout,
+                name=name,
+            )
+        if ct == "CGCNN":
+            return CGConv(edge_dim=self.edge_dim or 0, name=name)
+        if ct == "PNA":
+            return PNAConv(
+                out_dim,
+                deg_avg_log=self.pna_deg_avg_log,
+                deg_avg_lin=self.pna_deg_avg_lin,
+                edge_dim=self.edge_dim,
+                name=name,
+            )
+        raise ValueError(f"Unknown conv_type {ct}")
+
+    def setup(self):
+        if self.conv_type not in CONV_TYPES:
+            raise ValueError(f"Unknown conv_type {self.conv_type}")
+        gat = self.conv_type == "GAT"
+        h = self.gat_heads
+
+        # --- encoder (Base._init_conv, Base.py:99-105; GAT override
+        # GATStack.py:35-46: concat widths on all but the last layer) ---
+        convs, bns = [], []
+        if gat:
+            convs.append(self._make_conv(self.input_dim, self.hidden_dim, "conv_0"))
+            bns.append(MaskedBatchNorm(self.hidden_dim * h, name="bn_0"))
+            for i in range(1, max(self.num_conv_layers - 1, 1)):
+                convs.append(
+                    self._make_conv(self.hidden_dim * h, self.hidden_dim, f"conv_{i}")
+                )
+                bns.append(MaskedBatchNorm(self.hidden_dim * h, name=f"bn_{i}"))
+            i = max(self.num_conv_layers - 1, 1)
+            convs.append(
+                self._make_conv(
+                    self.hidden_dim * h, self.hidden_dim, f"conv_{i}", concat=False
+                )
+            )
+            bns.append(MaskedBatchNorm(self.hidden_dim, name=f"bn_{i}"))
+        else:
+            dims = [self.input_dim] + [self.enc_dim] * self.num_conv_layers
+            for i in range(self.num_conv_layers):
+                convs.append(self._make_conv(dims[i], dims[i + 1], f"conv_{i}"))
+                bns.append(MaskedBatchNorm(dims[i + 1], name=f"bn_{i}"))
+        self.convs = convs
+        self.batch_norms = bns
+
+        node_head_idx = [i for i, t in enumerate(self.output_type) if t == "node"]
+        self.node_nn_type = (
+            self.config_heads.get("node", {}).get("type") if node_head_idx else None
+        )
+
+        # --- node-head conv chain (Base._init_node_conv, Base.py:120-150; GAT
+        # override GATStack.py:48-86; CGCNN forbids 'conv' CGCNNStack.py:53-75) ---
+        nch, ncb, nco, ncob = [], [], [], []
+        if node_head_idx and self.node_nn_type == "conv":
+            if self.conv_type == "CGCNN":
+                raise ValueError(
+                    '"conv" node decoder is not supported for CGCNN; use "mlp" or '
+                    '"mlp_per_node"'
+                )
+            hd = list(self.config_heads["node"]["dim_headlayers"])
+            nlayers = self.config_heads["node"]["num_headlayers"]
+            # GAT concat widens hidden chain widths by `heads` and disables
+            # concat on the output conv (GATStack.py:48-86); mult=1 otherwise.
+            mult = h if gat else 1
+            nch.append(self._make_conv(self.enc_dim, hd[0], "node_conv_0"))
+            ncb.append(MaskedBatchNorm(hd[0] * mult, name="node_bn_0"))
+            for i in range(nlayers - 1):
+                nch.append(
+                    self._make_conv(hd[i] * mult, hd[i + 1], f"node_conv_{i + 1}")
+                )
+                ncb.append(MaskedBatchNorm(hd[i + 1] * mult, name=f"node_bn_{i + 1}"))
+            for k, ih in enumerate(node_head_idx):
+                nco.append(
+                    self._make_conv(
+                        hd[-1] * mult,
+                        self.output_dim[ih],
+                        f"node_out_conv_{k}",
+                        concat=False,
+                    )
+                )
+                ncob.append(
+                    MaskedBatchNorm(self.output_dim[ih], name=f"node_out_bn_{k}")
+                )
+        self.convs_node_hidden = nch
+        self.batch_norms_node_hidden = ncb
+        self.convs_node_output = nco
+        self.batch_norms_node_output = ncob
+
+        # --- heads (Base._multihead, Base.py:152-223) ---
+        if "graph" in self.config_heads and any(
+            t == "graph" for t in self.output_type
+        ):
+            gcfg = self.config_heads["graph"]
+            self.graph_shared = MLP(
+                tuple([gcfg["dim_sharedlayers"]] * gcfg["num_sharedlayers"]),
+                activate_final=True,
+                name="graph_shared",
+            )
+
+        heads = []
+        for ihead, (htype, hdim) in enumerate(zip(self.output_type, self.output_dim)):
+            if htype == "graph":
+                gcfg = self.config_heads["graph"]
+                dims = tuple(gcfg["dim_headlayers"][: gcfg["num_headlayers"]]) + (
+                    hdim + self.ilossweights_nll,
+                )
+                heads.append(
+                    MLP(
+                        dims,
+                        final_bias_value=self.initial_bias,
+                        name=f"head_{ihead}",
+                    )
+                )
+            elif htype == "node":
+                if self.node_nn_type in ("mlp", "mlp_per_node"):
+                    ncfg = self.config_heads["node"]
+                    heads.append(
+                        MLPNode(
+                            tuple(ncfg["dim_headlayers"][: ncfg["num_headlayers"]]),
+                            hdim,
+                            self.node_nn_type,
+                            num_nodes=self.num_nodes,
+                            name=f"head_{ihead}",
+                        )
+                    )
+                elif self.node_nn_type == "conv":
+                    heads.append(None)  # handled via convs_node_* chains
+                else:
+                    raise ValueError(
+                        f"Unknown node head type {self.node_nn_type}; use 'mlp', "
+                        "'mlp_per_node' or 'conv'"
+                    )
+            else:
+                raise ValueError(f"Unknown head type {htype}")
+        self.heads_nn = heads
+
+    def __call__(self, batch: GraphBatch, train: bool = False):
+        x = batch.node_features
+        edge_attr = batch.edge_features if self.use_edge_attr else None
+        # Reference encoder loop: x = relu(bn(conv(x))) (Base.py:236-243).
+        for conv, bn in zip(self.convs, self.batch_norms):
+            c = conv(
+                x,
+                batch.senders,
+                batch.receivers,
+                edge_attr,
+                batch.edge_mask,
+                batch.node_mask,
+                train=train,
+            )
+            x = nn.relu(bn(c, batch.node_mask, train))
+
+        # Masked global mean pool (Base.py:247-250).
+        x_graph = seg.segment_mean(
+            x, batch.node_graph, batch.num_graphs_pad, mask=batch.node_mask
+        )
+
+        outputs = []
+        inode = 0
+        for ihead, htype in enumerate(self.output_type):
+            if htype == "graph":
+                xg = self.graph_shared(x_graph)
+                outputs.append(self.heads_nn[ihead](xg))
+            else:
+                if self.node_nn_type == "conv":
+                    xn = x
+                    chain = list(
+                        zip(self.convs_node_hidden, self.batch_norms_node_hidden)
+                    ) + [
+                        (
+                            self.convs_node_output[inode],
+                            self.batch_norms_node_output[inode],
+                        )
+                    ]
+                    for conv, bn in chain:
+                        xn = conv(
+                            xn,
+                            batch.senders,
+                            batch.receivers,
+                            None,
+                            batch.edge_mask,
+                            batch.node_mask,
+                            train=train,
+                        )
+                        # Reference applies relu(bn(.)) through the output layer
+                        # too (Base.forward, Base.py:261-265).
+                        xn = nn.relu(bn(xn, batch.node_mask, train))
+                    inode += 1
+                    outputs.append(xn)
+                else:
+                    outputs.append(self.heads_nn[ihead](x, batch))
+        return outputs

@@ -1,0 +1,136 @@
+"""Model factory (reference /root/reference/hydragnn/models/create.py:28-178).
+
+Builds a HydraGNN flax module + initialized variables from the completed
+Architecture config block. The reference seeds torch.manual_seed(0) at creation
+(create.py:75); here initialization is keyed on PRNGKey(seed) with seed 0 default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..graphs.batch import GraphBatch
+from ..graphs.collate import collate_graphs
+from .base import HydraGNN
+from .convs import pna_degree_averages
+from .loss import normalize_task_weights
+
+
+def create_model_config(
+    config: Dict[str, Any], verbosity: int = 0, use_gpu: bool = True
+) -> HydraGNN:
+    return create_model(
+        model_type=config["model_type"],
+        input_dim=config["input_dim"],
+        hidden_dim=config["hidden_dim"],
+        output_dim=config["output_dim"],
+        output_type=config["output_type"],
+        output_heads=config["output_heads"],
+        task_weights=config["task_weights"],
+        num_conv_layers=config["num_conv_layers"],
+        freeze_conv=config.get("freeze_conv_layers", False),
+        initial_bias=config.get("initial_bias"),
+        num_nodes=config.get("num_nodes"),
+        max_neighbours=config.get("max_neighbours"),
+        edge_dim=config.get("edge_dim"),
+        pna_deg=config.get("pna_deg"),
+        verbosity=verbosity,
+    )
+
+
+def create_model(
+    model_type: str,
+    input_dim: int,
+    hidden_dim: int,
+    output_dim: Sequence[int],
+    output_type: Sequence[str],
+    output_heads: Dict[str, Any],
+    task_weights: Sequence[float],
+    num_conv_layers: int,
+    freeze_conv: bool = False,
+    initial_bias: Optional[float] = None,
+    num_nodes: Optional[int] = None,
+    max_neighbours: Optional[int] = None,
+    edge_dim: Optional[int] = None,
+    pna_deg: Optional[Sequence[float]] = None,
+    verbosity: int = 0,
+) -> HydraGNN:
+    if len(task_weights) != len(output_dim):
+        raise ValueError(
+            f"Inconsistent number of loss weights and tasks: {len(task_weights)} "
+            f"VS {len(output_dim)}"
+        )
+    from .base import CONV_TYPES
+
+    if model_type not in CONV_TYPES:
+        raise ValueError("Unknown model_type: {0}".format(model_type))
+    kwargs: Dict[str, Any] = {}
+    if model_type == "PNA":
+        assert pna_deg is not None, "PNA requires degree input."
+        avg_log, avg_lin = pna_degree_averages(pna_deg)
+        kwargs.update(pna_deg_avg_log=avg_log, pna_deg_avg_lin=avg_lin)
+    elif model_type == "MFC":
+        assert max_neighbours is not None, "MFC requires max_neighbours input."
+        kwargs.update(mfc_max_degree=int(max_neighbours))
+    elif model_type == "CGCNN":
+        hidden_dim = input_dim  # CGCNN preserves channels (CGCNNStack.py:31-42)
+    return HydraGNN(
+        conv_type=model_type,
+        input_dim=input_dim,
+        hidden_dim=hidden_dim,
+        output_dim=tuple(output_dim),
+        output_type=tuple(output_type),
+        config_heads=output_heads,
+        num_conv_layers=num_conv_layers,
+        task_weights=normalize_task_weights(task_weights),
+        freeze_conv=bool(freeze_conv),
+        num_nodes=num_nodes,
+        initial_bias=initial_bias,
+        edge_dim=edge_dim,
+        **kwargs,
+    )
+
+
+def init_model_variables(
+    model: HydraGNN, example_batch: GraphBatch, seed: int = 0
+) -> Dict[str, Any]:
+    rngs = {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(seed + 1)}
+    return model.init(rngs, example_batch, train=False)
+
+
+def make_example_batch(
+    input_dim: int,
+    output_dim: Sequence[int],
+    output_type: Sequence[str],
+    edge_dim: Optional[int] = None,
+    num_nodes: int = 4,
+) -> GraphBatch:
+    """A tiny structurally-valid batch for shape inference / init."""
+    from ..graphs.sample import GraphSample
+
+    n = num_nodes
+    x = np.ones((n, input_dim), dtype=np.float32)
+    ei = np.stack(
+        [np.arange(n, dtype=np.int32), (np.arange(n, dtype=np.int32) + 1) % n]
+    )
+    ea = np.ones((n, max(edge_dim or 1, 1)), dtype=np.float32)
+    total = sum(
+        d if t == "graph" else d * n for d, t in zip(output_dim, output_type)
+    )
+    y = np.zeros((total,), dtype=np.float32)
+    y_loc = np.zeros((1, len(output_dim) + 1), dtype=np.int64)
+    off = 0
+    for i, (d, t) in enumerate(zip(output_dim, output_type)):
+        off += d if t == "graph" else d * n
+        y_loc[0, i + 1] = off
+    s = GraphSample(x=x, pos=np.zeros((n, 3), np.float32), y=y, y_loc=y_loc,
+                    edge_index=ei, edge_attr=ea)
+    return collate_graphs(
+        [s],
+        head_types=output_type,
+        head_dims=output_dim,
+        edge_dim=edge_dim,
+    )

@@ -1,0 +1,130 @@
+"""Masked segment ops — the XLA replacement for torch-scatter/-sparse kernels that
+PyTorch-Geometric message passing leans on (reference conv calls:
+/root/reference/hydragnn/models/Base.py:236-243, global_mean_pool at Base.py:250).
+
+All ops take a static ``num_segments`` so shapes are compile-time constants, and an
+optional boolean mask marking valid rows. Under the GraphBatch padding contract
+(padding edges connect padding nodes) masks are usually only needed for statistics
+(mean/std/min/max/softmax) where identity elements differ from zero.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_BIG = 1e30
+
+
+def _expand(mask: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a [N] mask against [N, ...] data."""
+    return mask.reshape(mask.shape + (1,) * (like.ndim - mask.ndim))
+
+
+def segment_sum(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    if mask is not None:
+        data = jnp.where(_expand(mask, data), data, 0)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_count(
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    ones = jnp.ones(segment_ids.shape[0], dtype=jnp.float32)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0.0)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    total = segment_sum(data, segment_ids, num_segments, mask)
+    count = segment_count(segment_ids, num_segments, mask)
+    return total / jnp.maximum(count, 1.0).reshape(
+        count.shape + (1,) * (total.ndim - count.ndim)
+    )
+
+
+def segment_max(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    fill: float = 0.0,
+) -> jnp.ndarray:
+    if mask is not None:
+        data = jnp.where(_expand(mask, data), data, -_BIG)
+    out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    # Empty segments come back as -inf/-BIG: replace with `fill` so downstream
+    # matmuls stay finite (isolated nodes have no incoming messages).
+    return jnp.where(out <= -_BIG / 2, fill, out)
+
+
+def segment_min(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    fill: float = 0.0,
+) -> jnp.ndarray:
+    if mask is not None:
+        data = jnp.where(_expand(mask, data), data, _BIG)
+    out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+    return jnp.where(out >= _BIG / 2, fill, out)
+
+
+def segment_std(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    """Per-segment standard deviation, sqrt(relu(E[x^2]-E[x]^2) + eps) like PyG's
+    PNA 'std' aggregator (uses a small eps for a finite gradient at zero)."""
+    mean = segment_mean(data, segment_ids, num_segments, mask)
+    mean_sq = segment_mean(jnp.square(data), segment_ids, num_segments, mask)
+    var = jax.nn.relu(mean_sq - jnp.square(mean))
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(
+    logits: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Numerically-stable softmax normalized within each segment (GATv2 attention
+    over incoming edges). Masked-out rows get weight 0."""
+    if mask is not None:
+        logits = jnp.where(_expand(mask, logits), logits, -_BIG)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(seg_max <= -_BIG / 2, 0.0, seg_max)
+    shifted = logits - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    if mask is not None:
+        exp = jnp.where(_expand(mask, exp), exp, 0.0)
+    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    return exp / jnp.maximum(denom[segment_ids], 1e-16)
+
+
+def masked_mean(data: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    """Mean over rows where mask is True (for batch-norm statistics over padded
+    node arrays)."""
+    m = jnp.broadcast_to(_expand(mask, data), data.shape).astype(data.dtype)
+    total = jnp.sum(data * m, axis=axis)
+    count = jnp.sum(m, axis=axis)
+    return total / jnp.maximum(count, 1.0)

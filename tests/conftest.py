@@ -1,0 +1,17 @@
+"""Test-session setup: force JAX onto a virtual 8-device CPU platform.
+
+The environment pins JAX_PLATFORMS=axon (one tunneled TPU chip) via sitecustomize;
+tests must run hermetically on host CPU with 8 virtual devices so the distributed
+(data-parallel mesh) paths are exercised the way the reference CI exercises DDP
+with 2 MPI ranks (/root/reference/.github/workflows/CI.yml:47-52).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

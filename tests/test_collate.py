@@ -1,0 +1,67 @@
+"""Collator tests: target unpacking (the packed y/y_loc contract,
+reference serialized_dataset_loader.py:220-261) and the padding contract."""
+
+import numpy as np
+
+from hydragnn_tpu.graphs import GraphSample, collate_graphs, compute_pad_sizes
+
+
+def _make_sample(n, graph_dim=2, node_dims=(1, 3)):
+    """Sample with one graph feature (dim graph_dim) + node heads of node_dims."""
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    pos = np.random.RandomState(n).rand(n, 3).astype(np.float32)
+    heads = [np.arange(graph_dim, dtype=np.float32) + 10 * n]
+    for d in node_dims:
+        heads.append((np.arange(n * d, dtype=np.float32) + 100 * n).reshape(n * d))
+    y = np.concatenate([h.reshape(-1) for h in heads])
+    y_loc = np.zeros((1, len(heads) + 1), dtype=np.int64)
+    off = 0
+    for i, h in enumerate(heads):
+        off += h.size
+        y_loc[0, i + 1] = off
+    ei = np.stack([np.arange(n), (np.arange(n) + 1) % n]).astype(np.int32)
+    ea = np.ones((n, 1), dtype=np.float32) * n
+    return GraphSample(x=x, pos=pos, y=y, y_loc=y_loc, edge_index=ei, edge_attr=ea)
+
+
+def pytest_collate_shapes_and_masks():
+    graphs = [_make_sample(3), _make_sample(5)]
+    types = ("graph", "node", "node")
+    dims = (2, 1, 3)
+    b = collate_graphs(graphs, types, dims)
+    assert b.node_features.shape[0] >= 9  # 8 real + ≥1 pad
+    assert int(b.node_mask.sum()) == 8
+    assert int(b.edge_mask.sum()) == 8
+    assert int(b.graph_mask.sum()) == 2
+    # Padding edges only touch padding nodes.
+    pad_edges = ~np.asarray(b.edge_mask)
+    assert not np.asarray(b.node_mask)[np.asarray(b.senders)[pad_edges]].any()
+    assert not np.asarray(b.node_mask)[np.asarray(b.receivers)[pad_edges]].any()
+    # Padding nodes belong to a padding graph.
+    pad_nodes = ~np.asarray(b.node_mask)
+    assert not np.asarray(b.graph_mask)[np.asarray(b.node_graph)[pad_nodes]].any()
+
+
+def pytest_collate_target_unpacking():
+    n = 4
+    g = _make_sample(n)
+    types = ("graph", "node", "node")
+    dims = (2, 1, 3)
+    b = collate_graphs([g], types, dims)
+    # Graph head: first 2 of packed y.
+    assert np.allclose(b.targets[0][0], g.y[:2])
+    # Node head dim 1: next n entries.
+    assert np.allclose(b.targets[1][:n, 0], g.y[2 : 2 + n])
+    # Node head dim 3: row-major [n,3].
+    assert np.allclose(b.targets[2][:n], g.y[2 + n :].reshape(n, 3))
+    # Edge index offsets: second graph's edges shifted by first graph's n.
+    b2 = collate_graphs([g, _make_sample(3)], types, dims)
+    assert np.asarray(b2.senders)[np.asarray(b2.edge_mask)].max() >= n
+
+
+def pytest_pad_sizes_fit_worst_batch():
+    graphs = [_make_sample(n) for n in (2, 3, 5, 7, 11)]
+    n_pad, e_pad, g_pad = compute_pad_sizes(graphs, batch_size=2)
+    assert n_pad > 11 + 7
+    assert e_pad >= 11 + 7
+    assert g_pad == 3

@@ -1,0 +1,56 @@
+"""Unit tests for masked segment ops against hand-computed small graphs
+(build-plan step 1, SURVEY.md §7)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops import segment as seg
+
+
+def pytest_segment_basic():
+    data = jnp.array([[1.0], [2.0], [3.0], [4.0], [100.0]])
+    ids = jnp.array([0, 0, 1, 1, 2])
+    mask = jnp.array([True, True, True, True, False])
+
+    assert np.allclose(seg.segment_sum(data, ids, 3, mask), [[3.0], [7.0], [0.0]])
+    assert np.allclose(seg.segment_mean(data, ids, 3, mask), [[1.5], [3.5], [0.0]])
+    assert np.allclose(seg.segment_max(data, ids, 3, mask), [[2.0], [4.0], [0.0]])
+    assert np.allclose(seg.segment_min(data, ids, 3, mask), [[1.0], [3.0], [0.0]])
+
+
+def pytest_segment_std():
+    data = jnp.array([[1.0], [3.0], [5.0], [5.0]])
+    ids = jnp.array([0, 0, 1, 1])
+    out = seg.segment_std(data, ids, 2, eps=0.0)
+    assert np.allclose(out, [[1.0], [0.0]], atol=1e-6)
+
+
+def pytest_segment_softmax():
+    logits = jnp.array([1.0, 2.0, 3.0, 50.0])
+    ids = jnp.array([0, 0, 0, 1])
+    mask = jnp.array([True, True, True, False])
+    out = np.asarray(seg.segment_softmax(logits, ids, 2, mask))
+    expected = np.exp([1.0, 2.0, 3.0])
+    expected = expected / expected.sum()
+    assert np.allclose(out[:3], expected, atol=1e-6)
+    assert out[3] == 0.0
+    # Large logits must not overflow (max-subtraction).
+    big = seg.segment_softmax(jnp.array([1000.0, 1001.0]), jnp.array([0, 0]), 1)
+    assert np.all(np.isfinite(np.asarray(big)))
+
+
+def pytest_segment_empty_segments_finite():
+    data = jnp.ones((3, 2))
+    ids = jnp.array([0, 0, 0])
+    for fn in (seg.segment_max, seg.segment_min):
+        out = np.asarray(fn(data, ids, 4))
+        assert np.all(np.isfinite(out))
+        assert np.allclose(out[1:], 0.0)
+    out = np.asarray(seg.segment_std(data, ids, 4))
+    assert np.all(np.isfinite(out))
+
+
+def pytest_masked_mean():
+    x = jnp.array([[1.0, 2.0], [3.0, 4.0], [99.0, 99.0]])
+    mask = jnp.array([True, True, False])
+    assert np.allclose(seg.masked_mean(x, mask, axis=0), [2.0, 3.0])
