@@ -208,16 +208,19 @@ class PNAConv(nn.Module):
         combined = agg[:, None, :, :] * scale[:, :, None, None]
         combined = combined.reshape(n, len(self.scalers) * len(self.aggregators) * f)
         out = jnp.concatenate([x, combined], axis=-1)
-        return nn.Dense(self.out_dim, name="post_nn")(out)
+        out = nn.Dense(self.out_dim, name="post_nn")(out)
+        # PyG applies a final linear after the tower post-MLPs (PNAConv.lin).
+        return nn.Dense(self.out_dim, name="lin")(out)
 
 
 def pna_degree_averages(deg_histogram: Sequence[float]) -> Tuple[float, float]:
     """avg(log(d+1)) and avg(d) over the training-set in-degree histogram, the two
-    normalizers PNA scalers need (degrees clamped to ≥1, as PyG does)."""
+    normalizers PNA scalers need. Averages use raw bin degrees (PyG clamps only
+    the runtime degree, not the histogram average)."""
     import numpy as np
 
     hist = np.asarray(deg_histogram, dtype=np.float64)
-    degrees = np.maximum(np.arange(len(hist)), 1)
+    degrees = np.arange(len(hist))
     total = hist.sum()
     if total == 0:
         return 1.0, 1.0

@@ -47,7 +47,10 @@ def multihead_rmse_loss(
         outputs, batch.targets, output_type, task_weights
     ):
         mask = batch.graph_mask if htype == "graph" else batch.node_mask
-        rmse = jnp.sqrt(head_mse(pred, target, mask))
+        # max() floor keeps the sqrt VJP finite when a head's masked MSE is
+        # exactly 0 (all-masked padding batches from stack_batches would
+        # otherwise inject NaN grads that pmean spreads to every replica).
+        rmse = jnp.sqrt(jnp.maximum(head_mse(pred, target, mask), 1e-16))
         rmses.append(rmse)
         total = total + w * rmse
     return total, jnp.stack(rmses)

@@ -1,0 +1,7 @@
+from .distributed import (
+    barrier,
+    get_comm_size_and_rank,
+    init_comm_size_and_rank,
+    make_mesh,
+    setup_ddp,
+)

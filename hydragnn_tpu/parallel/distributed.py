@@ -1,0 +1,86 @@
+"""Distributed runtime — XLA-collective replacement for the reference's
+torch.distributed/NCCL layer (/root/reference/hydragnn/utils/distributed.py).
+
+The reference wires DDP over NCCL/Gloo with env-var rendezvous (OpenMPI/SLURM/LSF)
+and wraps the model (distributed.py:110-226). Here the distribution contract is the
+pjit/shard_map train step itself (SURVEY.md §7 pillar 2): this module only owns
+process bootstrap (jax.distributed), the device mesh, host barriers, and rank
+helpers. There is no model wrapper — gradient allreduce is a psum inside the
+compiled step, riding ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def init_comm_size_and_rank() -> Tuple[int, int]:
+    """World size / rank from the same scheduler env the reference parses
+    (OpenMPI, SLURM — distributed.py:77-94), else single process."""
+    world_size, world_rank = 1, 0
+    if os.getenv("OMPI_COMM_WORLD_SIZE") and os.getenv("OMPI_COMM_WORLD_RANK"):
+        world_size = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+        world_rank = int(os.environ["OMPI_COMM_WORLD_RANK"])
+    elif os.getenv("SLURM_NPROCS") and os.getenv("SLURM_PROCID"):
+        world_size = int(os.environ["SLURM_NPROCS"])
+        world_rank = int(os.environ["SLURM_PROCID"])
+    return world_size, world_rank
+
+
+def setup_ddp(coordinator_address: Optional[str] = None) -> Tuple[int, int]:
+    """Process-group bootstrap (reference setup_ddp, distributed.py:110-158).
+
+    Multi-process: jax.distributed.initialize with scheduler-env rendezvous.
+    Single-process (or rendezvous env missing): sequential fallback, like the
+    reference's try/except (distributed.py:134-157).
+    """
+    world_size, world_rank = init_comm_size_and_rank()
+    if world_size > 1 and jax.process_count() == 1:
+        try:
+            if coordinator_address is None:
+                master_addr = os.getenv("MASTER_ADDR", "127.0.0.1")
+                master_port = os.getenv("MASTER_PORT", "8889")
+                coordinator_address = f"{master_addr}:{master_port}"
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=world_size,
+                process_id=world_rank,
+            )
+        except Exception as e:  # sequential fallback (distributed.py:155-157)
+            print(f"Fall back to sequential execution mode: {e}")
+            return 1, 0
+    return get_comm_size_and_rank()
+
+
+def get_comm_size_and_rank() -> Tuple[int, int]:
+    return jax.process_count(), jax.process_index()
+
+
+def barrier(name: str = "hydragnn_barrier") -> None:
+    """Host-level barrier (reference dist.barrier around data prep/log dirs)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def get_device_list():
+    return jax.local_devices()
+
+
+def make_mesh(
+    data_axis: Optional[int] = None, graph_axis: int = 1
+) -> jax.sharding.Mesh:
+    """Device mesh for the train step: 'data' (batch/DP) × 'graph'
+    (intra-graph node/edge sharding — the long-context analog axis)."""
+    n = len(jax.devices())
+    if data_axis is None:
+        data_axis = n // graph_axis
+    devices = np.asarray(jax.devices()[: data_axis * graph_axis]).reshape(
+        data_axis, graph_axis
+    )
+    return jax.sharding.Mesh(devices, ("data", "graph"))

@@ -1,0 +1,6 @@
+from .postprocess import (
+    output_denormalize,
+    unscale_features_by_num_nodes,
+    unscale_features_by_num_nodes_config,
+)
+from .visualizer import Visualizer

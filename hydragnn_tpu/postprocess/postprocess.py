@@ -1,0 +1,49 @@
+"""Prediction post-processing (reference /root/reference/hydragnn/postprocess/
+postprocess.py:13-54), vectorized (the reference's triple python loop is listed as
+a hot spot in SURVEY.md §3.6)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def output_denormalize(y_minmax, true_values, predicted_values):
+    """Undo per-head min-max normalization in place: v*(ymax-ymin)+ymin."""
+    for ihead in range(len(y_minmax)):
+        ymin = np.asarray(y_minmax[ihead][0])
+        ymax = np.asarray(y_minmax[ihead][1])
+        predicted_values[ihead] = predicted_values[ihead] * (ymax - ymin) + ymin
+        true_values[ihead] = true_values[ihead] * (ymax - ymin) + ymin
+    return true_values, predicted_values
+
+
+def unscale_features_by_num_nodes(
+    datasets_list, scaled_index_list: Sequence[int], nodes_num_list: Sequence[int]
+):
+    """Multiply ``*_scaled_num_nodes`` head values back by each sample's node
+    count (postprocess.py:29-41). Values are [num_heads][num_samples][...]."""
+    nodes = np.asarray(nodes_num_list)
+    for dataset in datasets_list:
+        for scaled_index in scaled_index_list:
+            head_value = dataset[scaled_index]
+            for isample in range(len(nodes)):
+                head_value[isample] = head_value[isample] * nodes[isample]
+    return datasets_list
+
+
+def unscale_features_by_num_nodes_config(config, datasets_list, nodes_num_list):
+    var_config = config["NeuralNetwork"]["Variables_of_interest"]
+    output_names = var_config["output_names"]
+    scaled_feature_index = [
+        i for i, nm in enumerate(output_names) if "_scaled_num_nodes" in nm
+    ]
+    if scaled_feature_index:
+        assert var_config[
+            "denormalize_output"
+        ], "Cannot unscale features without 'denormalize_output'"
+        datasets_list = unscale_features_by_num_nodes(
+            datasets_list, scaled_feature_index, nodes_num_list
+        )
+    return datasets_list

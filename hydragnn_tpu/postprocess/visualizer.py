@@ -1,0 +1,124 @@
+"""Result plotting (reference /root/reference/hydragnn/postprocess/visualizer.py:
+24-735): parity/scatter plots per head, error histograms, loss-history dump
+(pickled ``history_loss.pkl``) + curves, node-count histogram. matplotlib with the
+Agg backend — file output only."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+
+class Visualizer:
+    def __init__(
+        self,
+        model_with_config_name: str,
+        node_feature: Sequence = (),
+        num_heads: int = 1,
+        head_dims: Sequence[int] = (1,),
+    ):
+        self.true_values = []
+        self.predicted_values = []
+        self.model_with_config_name = model_with_config_name
+        os.makedirs(self.model_with_config_name, exist_ok=True)
+        self.node_feature = node_feature
+        self.num_heads = num_heads
+        self.head_dims = list(head_dims)
+
+    # ----------------------------------------------------------- loss history
+    def plot_history(self, history: dict) -> None:
+        """Dump pickled history + train/val/test curves
+        (visualizer.py:626-688)."""
+        with open(
+            os.path.join(self.model_with_config_name, "history_loss.pkl"), "wb"
+        ) as f:
+            pickle.dump(history, f)
+
+        fig, axs = plt.subplots(1, 2, figsize=(12, 4.5))
+        for key, label in (
+            ("total_loss_train", "train"),
+            ("total_loss_val", "validation"),
+            ("total_loss_test", "test"),
+        ):
+            axs[0].plot(history[key], label=label)
+        axs[0].set_xlabel("epoch")
+        axs[0].set_ylabel("total loss")
+        axs[0].set_yscale("log")
+        axs[0].legend()
+
+        task_train = np.asarray(history["task_loss_train"])
+        if task_train.ndim == 2:
+            for ih in range(task_train.shape[1]):
+                axs[1].plot(task_train[:, ih], label=f"task {ih}")
+            axs[1].set_xlabel("epoch")
+            axs[1].set_ylabel("task RMSE (train)")
+            axs[1].set_yscale("log")
+            axs[1].legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.model_with_config_name, "history_loss.png"))
+        plt.close(fig)
+
+    # ----------------------------------------------------------- parity plots
+    def create_parity_plots(
+        self, true_values: List[np.ndarray], predicted_values: List[np.ndarray]
+    ) -> None:
+        """Per-head predicted-vs-true scatter (scalar plots,
+        visualizer.py:280-383)."""
+        for ihead, (tv, pv) in enumerate(zip(true_values, predicted_values)):
+            tv = np.asarray(tv).reshape(-1)
+            pv = np.asarray(pv).reshape(-1)
+            fig, ax = plt.subplots(figsize=(5, 5))
+            ax.scatter(tv, pv, s=6, alpha=0.5, edgecolors="none")
+            lo = min(tv.min(), pv.min()) if tv.size else 0.0
+            hi = max(tv.max(), pv.max()) if tv.size else 1.0
+            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+            ax.set_title(f"head {ihead}")
+            fig.tight_layout()
+            fig.savefig(
+                os.path.join(
+                    self.model_with_config_name, f"parity_head{ihead}.png"
+                )
+            )
+            plt.close(fig)
+
+    create_scatter_plots = create_parity_plots
+
+    # ------------------------------------------------------- error histograms
+    def create_error_histograms(
+        self, true_values: List[np.ndarray], predicted_values: List[np.ndarray]
+    ) -> None:
+        """Per-head histogram of (pred − true) (visualizer.py:384-463)."""
+        for ihead, (tv, pv) in enumerate(zip(true_values, predicted_values)):
+            err = (np.asarray(pv) - np.asarray(tv)).reshape(-1)
+            fig, ax = plt.subplots(figsize=(5, 4))
+            ax.hist(err, bins=50)
+            ax.set_xlabel("error (pred - true)")
+            ax.set_ylabel("count")
+            ax.set_title(f"head {ihead}")
+            fig.tight_layout()
+            fig.savefig(
+                os.path.join(
+                    self.model_with_config_name, f"error_hist_head{ihead}.png"
+                )
+            )
+            plt.close(fig)
+
+    # -------------------------------------------------------------- num nodes
+    def num_nodes_plot(self, nodes_num_list: Sequence[int]) -> None:
+        """Histogram of graph sizes in the test set (visualizer.py:727-735)."""
+        fig, ax = plt.subplots(figsize=(5, 4))
+        ax.hist(np.asarray(nodes_num_list), bins=30)
+        ax.set_xlabel("num nodes")
+        ax.set_ylabel("count")
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.model_with_config_name, "num_nodes.png"))
+        plt.close(fig)

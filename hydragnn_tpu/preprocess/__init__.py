@@ -1,0 +1,20 @@
+from .dataloader import GraphDataLoader
+from .dataset_descriptors import AtomFeatures, StructureFeatures
+from .graph_build import (
+    add_edge_lengths,
+    check_if_graph_size_variable,
+    compute_edges,
+    normalize_rotation,
+    periodic_radius_graph,
+    radius_graph,
+)
+from .load_data import (
+    create_dataloaders,
+    dataset_loading_and_splitting,
+    load_train_val_test_sets,
+    total_to_train_val_test_pkls,
+    transform_raw_data_to_serialized,
+)
+from .raw_loader import RawDataLoader
+from .serialized_loader import SerializedDataLoader, update_predicted_values
+from .splitting import compositional_stratified_splitting, split_dataset

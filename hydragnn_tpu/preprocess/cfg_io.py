@@ -1,0 +1,140 @@
+"""Minimal AtomEye (extended) CFG reader/writer.
+
+The reference reads CFG via ase.io.cfg.read_cfg
+(/root/reference/hydragnn/preprocess/raw_dataset_loader.py:183-207); ase is not in
+this environment, so this module implements the subset of the format the EAM
+example datasets use: extended CFG with ``.NO_VELOCITY.``, ``entry_count``,
+``auxiliary[i]`` declarations, per-species ``mass`` + element-symbol lines, and
+reduced coordinates scaled by ``A`` · H0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict
+
+import numpy as np
+
+_SYMBOLS = (
+    "H He Li Be B C N O F Ne Na Mg Al Si P S Cl Ar K Ca Sc Ti V Cr Mn Fe Co Ni "
+    "Cu Zn Ga Ge As Se Br Kr Rb Sr Y Zr Nb Mo Tc Ru Rh Pd Ag Cd In Sn Sb Te I "
+    "Xe Cs Ba La Ce Pr Nd Pm Sm Eu Gd Tb Dy Ho Er Tm Yb Lu Hf Ta W Re Os Ir Pt "
+    "Au Hg Tl Pb Bi Po At Rn Fr Ra Ac Th Pa U Np Pu"
+).split()
+ATOMIC_NUMBERS: Dict[str, int] = {s: i + 1 for i, s in enumerate(_SYMBOLS)}
+SYMBOLS_BY_NUMBER: Dict[int, str] = {v: k for k, v in ATOMIC_NUMBERS.items()}
+
+
+@dataclasses.dataclass
+class CfgData:
+    positions: np.ndarray  # [n, 3] cartesian
+    cell: np.ndarray  # [3, 3]
+    numbers: np.ndarray  # [n] atomic numbers
+    masses: np.ndarray  # [n]
+    aux: Dict[str, np.ndarray]  # name → [n]
+
+
+def read_cfg(filepath: str) -> CfgData:
+    with open(filepath, "r", encoding="utf-8") as f:
+        lines = [ln.strip() for ln in f if ln.strip() and not ln.startswith("#")]
+
+    n = None
+    scale = 1.0
+    h0 = np.eye(3)
+    entry_count = None
+    aux_names = []
+    body_start = None
+    for idx, ln in enumerate(lines):
+        if ln.startswith("Number of particles"):
+            n = int(ln.split("=")[1])
+        elif ln.startswith("A ") or ln.startswith("A="):
+            scale = float(ln.split("=")[1].split()[0])
+        elif ln.startswith("H0("):
+            m = re.match(r"H0\((\d),(\d)\)\s*=\s*([-\d.eE+]+)", ln)
+            h0[int(m.group(1)) - 1, int(m.group(2)) - 1] = float(m.group(3))
+        elif ln.startswith("entry_count"):
+            entry_count = int(ln.split("=")[1])
+        elif ln.startswith("auxiliary["):
+            m = re.match(r"auxiliary\[(\d+)\]\s*=\s*(\S+)", ln)
+            aux_names.append(m.group(2))
+        elif ln == ".NO_VELOCITY.":
+            pass
+        else:
+            first_tokens = ln.split()
+            if body_start is None and re.match(r"^[-\d.]", first_tokens[0]):
+                # Header lines all start with a keyword; the body starts at the
+                # first bare number (a per-species mass, or a legacy atom row).
+                if idx > 0 and n is not None:
+                    body_start = idx
+                    break
+    assert n is not None, f"{filepath}: missing 'Number of particles'"
+    cell = scale * h0
+
+    positions, numbers, masses = [], [], []
+    aux_vals = {name: [] for name in aux_names}
+    extended = entry_count is not None
+    if extended:
+        naux = entry_count - 3
+        cur_mass, cur_z = None, None
+        i = body_start
+        while i < len(lines):
+            tokens = lines[i].split()
+            if len(tokens) == 1 and re.match(r"^[\d.]", tokens[0]):
+                cur_mass = float(tokens[0])
+                cur_z = ATOMIC_NUMBERS[lines[i + 1].split()[0]]
+                i += 2
+                continue
+            frac = np.array([float(t) for t in tokens[:3]])
+            positions.append(frac @ cell)
+            masses.append(cur_mass)
+            numbers.append(cur_z)
+            for k in range(naux):
+                name = aux_names[k] if k < len(aux_names) else f"aux{k}"
+                aux_vals.setdefault(name, []).append(float(tokens[3 + k]))
+            i += 1
+    else:
+        # Legacy rows: mass type x y z [vx vy vz]
+        for ln in lines[body_start:]:
+            tokens = ln.split()
+            masses.append(float(tokens[0]))
+            numbers.append(ATOMIC_NUMBERS[tokens[1]])
+            frac = np.array([float(t) for t in tokens[2:5]])
+            positions.append(frac @ cell)
+
+    return CfgData(
+        positions=np.asarray(positions, dtype=np.float64),
+        cell=cell,
+        numbers=np.asarray(numbers, dtype=np.int64),
+        masses=np.asarray(masses, dtype=np.float64),
+        aux={k: np.asarray(v, dtype=np.float64) for k, v in aux_vals.items()},
+    )
+
+
+def write_cfg(filepath: str, data: CfgData) -> None:
+    """Extended-CFG writer (used by examples/tests to fabricate datasets)."""
+    n = len(data.numbers)
+    aux_names = list(data.aux.keys())
+    inv_cell = np.linalg.inv(data.cell)
+    with open(filepath, "w", encoding="utf-8") as f:
+        f.write(f"Number of particles = {n}\n")
+        f.write("A = 1.0 Angstrom\n")
+        for i in range(3):
+            for j in range(3):
+                f.write(f"H0({i + 1},{j + 1}) = {data.cell[i, j]:.8f}\n")
+        f.write(".NO_VELOCITY.\n")
+        f.write(f"entry_count = {3 + len(aux_names)}\n")
+        for k, name in enumerate(aux_names):
+            f.write(f"auxiliary[{k}] = {name} [au]\n")
+        order = np.argsort(data.numbers, kind="stable")
+        last_z = None
+        for i in order:
+            z = int(data.numbers[i])
+            if z != last_z:
+                f.write(f"{data.masses[i]:.4f}\n{SYMBOLS_BY_NUMBER[z]}\n")
+                last_z = z
+            frac = data.positions[i] @ inv_cell
+            row = " ".join(f"{v:.8f}" for v in frac)
+            for name in aux_names:
+                row += f" {data.aux[name][i]:.8f}"
+            f.write(row + "\n")

@@ -1,0 +1,98 @@
+"""Batch iterator over GraphSamples → padded GraphBatches.
+
+Replaces torch_geometric DataLoader + torch DistributedSampler (reference
+/root/reference/hydragnn/preprocess/load_data.py:53-86). Sharding follows
+DistributedSampler semantics: indices are globally shuffled with a per-epoch seed
+(the ``sampler.set_epoch`` contract, train_validate_test.py:96-97), padded to a
+multiple of the shard count by wrapping around, then dealt round-robin so every
+shard sees the same number of batches. Pad sizes are computed once over the whole
+dataset so every shard/batch compiles to the same XLA shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..graphs.batch import GraphBatch
+from ..graphs.collate import collate_graphs, compute_pad_sizes
+from ..graphs.sample import GraphSample
+
+
+class GraphDataLoader:
+    def __init__(
+        self,
+        dataset: Sequence[GraphSample],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        num_shards: int = 1,
+        shard_rank: int = 0,
+        head_types: Optional[Sequence[str]] = None,
+        head_dims: Optional[Sequence[int]] = None,
+        edge_dim: Optional[int] = None,
+    ):
+        self.dataset = list(dataset)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.num_shards = num_shards
+        self.shard_rank = shard_rank
+        self.head_types = tuple(head_types) if head_types else None
+        self.head_dims = tuple(head_dims) if head_dims else None
+        self.edge_dim = edge_dim
+        self.epoch = 0
+        if self.dataset:
+            self._n_pad, self._e_pad, self._g_pad = compute_pad_sizes(
+                self.dataset, batch_size
+            )
+        else:
+            self._n_pad = self._e_pad = self._g_pad = 0
+
+    # -- reference parity: sampler.set_epoch reshuffles DP shards each epoch.
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def set_head_spec(
+        self, head_types: Sequence[str], head_dims: Sequence[int]
+    ) -> None:
+        """Called by config completion once output heads are inferred from data."""
+        self.head_types = tuple(head_types)
+        self.head_dims = tuple(head_dims)
+
+    @property
+    def pad_sizes(self):
+        return self._n_pad, self._e_pad, self._g_pad
+
+    def _shard_indices(self) -> List[int]:
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        if self.num_shards > 1:
+            # Wrap-pad so all shards get equal counts (DistributedSampler does
+            # the same duplication), then deal round-robin.
+            per_shard = -(-n // self.num_shards)
+            padded = np.resize(idx, per_shard * self.num_shards)
+            idx = padded[self.shard_rank :: self.num_shards]
+        return idx.tolist()
+
+    def __len__(self) -> int:
+        n = len(self._shard_indices())
+        return -(-n // self.batch_size) if n else 0
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        idx = self._shard_indices()
+        for start in range(0, len(idx), self.batch_size):
+            chunk = [self.dataset[i] for i in idx[start : start + self.batch_size]]
+            yield collate_graphs(
+                chunk,
+                head_types=self.head_types or (),
+                head_dims=self.head_dims or (),
+                num_nodes_pad=self._n_pad,
+                num_edges_pad=self._e_pad,
+                num_graphs_pad=self._g_pad,
+                edge_dim=self.edge_dim,
+            )

@@ -1,0 +1,16 @@
+"""Column-semantics enums for LSMS-format atomistic datasets
+(reference /root/reference/hydragnn/preprocess/dataset_descriptors.py:15-32)."""
+
+from enum import IntEnum
+
+
+class AtomFeatures(IntEnum):
+    NUM_OF_PROTONS = 0
+    CHARGE_DENSITY = 1
+    MAGNETIC_MOMENT = 2
+
+
+class StructureFeatures(IntEnum):
+    FREE_ENERGY = 0
+    CHARGE_DENSITY = 1
+    MAGNETIC_MOMENT = 2

@@ -1,0 +1,175 @@
+"""Host-side radius-graph construction — flat (cKDTree) and periodic (own cell-image
+neighbor list; the reference delegates to torch-cluster RadiusGraph and
+ase.neighborlist.neighbor_list, /root/reference/hydragnn/preprocess/utils.py:51-123).
+
+Graph building stays OUT of the XLA graph: it is ragged, data-dependent work that
+belongs in the prefetching input pipeline (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+from ..graphs.sample import GraphSample
+
+
+def radius_graph(
+    pos: np.ndarray, radius: float, max_neighbours: int, loop: bool = False
+):
+    """Edges (j → i) for all j within `radius` of i, nearest-first, capped at
+    `max_neighbours` per receiver (torch-cluster radius_graph semantics)."""
+    pos = np.asarray(pos, dtype=np.float64)
+    tree = cKDTree(pos)
+    senders, receivers = [], []
+    for i, nbrs in enumerate(tree.query_ball_point(pos, r=radius)):
+        nbrs = [j for j in nbrs if loop or j != i]
+        if len(nbrs) > max_neighbours:
+            d = np.linalg.norm(pos[nbrs] - pos[i], axis=1)
+            nbrs = [nbrs[k] for k in np.argsort(d, kind="stable")[:max_neighbours]]
+        senders.extend(nbrs)
+        receivers.extend([i] * len(nbrs))
+    return (
+        np.asarray([senders, receivers], dtype=np.int64).reshape(2, -1),
+        None,
+    )
+
+
+def periodic_radius_graph(
+    pos: np.ndarray,
+    cell: np.ndarray,
+    radius: float,
+    max_neighbours: int | None = None,
+    loop: bool = False,
+):
+    """Periodic neighbor list over cell images (ase.neighborlist.neighbor_list("ijd")
+    equivalent). Returns (edge_index [2,E], lengths [E]).
+
+    Self-pairs across nonzero images ARE included (an atom sees its own periodic
+    copy); the zero-image self pair only with loop=True. The image search range per
+    axis is ceil(radius / cell-height) with cell heights from the reciprocal cell.
+    """
+    pos = np.asarray(pos, dtype=np.float64)
+    cell = np.asarray(cell, dtype=np.float64).reshape(3, 3)
+    n = pos.shape[0]
+
+    # Height of the cell along each reciprocal direction bounds how many images
+    # can fall within `radius`.
+    volume = abs(np.linalg.det(cell))
+    heights = np.empty(3)
+    for k in range(3):
+        cross = np.cross(cell[(k + 1) % 3], cell[(k + 2) % 3])
+        heights[k] = volume / np.linalg.norm(cross)
+    n_images = np.ceil(radius / heights).astype(int)
+
+    shifts = [
+        np.array([i, j, k], dtype=np.float64)
+        for i in range(-n_images[0], n_images[0] + 1)
+        for j in range(-n_images[1], n_images[1] + 1)
+        for k in range(-n_images[2], n_images[2] + 1)
+    ]
+
+    src, dst, lengths = [], [], []
+    tree = cKDTree(pos)
+    for shift in shifts:
+        offset = shift @ cell
+        zero_shift = not shift.any()
+        # neighbors of (pos_j + offset) around each i: pairs (i, j) with
+        # |pos_i - pos_j - offset| <= radius.
+        shifted_tree = cKDTree(pos + offset)
+        pairs = tree.query_ball_tree(shifted_tree, r=radius)
+        for i, js in enumerate(pairs):
+            for j in js:
+                if zero_shift and i == j and not loop:
+                    continue
+                d = np.linalg.norm(pos[i] - pos[j] - offset)
+                src.append(j)
+                dst.append(i)
+                lengths.append(d)
+
+    edge_index = np.asarray([src, dst], dtype=np.int64).reshape(2, -1)
+    lengths = np.asarray(lengths, dtype=np.float64)
+
+    # Reference asserts no duplicate (i, j) pairs after coalescing — multiple
+    # images of the same pair within the cutoff mean radius/cell are inconsistent
+    # (preprocess/utils.py:108-116).
+    if edge_index.shape[1]:
+        uniq = len({(int(a), int(b)) for a, b in edge_index.T})
+        assert uniq == edge_index.shape[1], (
+            "Adding periodic boundary conditions would result in duplicate edges. "
+            "Cutoff radius must be reduced or system size increased."
+        )
+    if max_neighbours is not None:
+        keep = _cap_neighbors(edge_index, lengths, max_neighbours)
+        edge_index, lengths = edge_index[:, keep], lengths[keep]
+    return edge_index, lengths
+
+
+def _cap_neighbors(edge_index, lengths, max_neighbours):
+    keep = []
+    by_receiver = {}
+    for e, r in enumerate(edge_index[1]):
+        by_receiver.setdefault(int(r), []).append(e)
+    for r, edges in by_receiver.items():
+        if len(edges) > max_neighbours:
+            order = np.argsort(lengths[edges], kind="stable")[:max_neighbours]
+            edges = [edges[k] for k in order]
+        keep.extend(edges)
+    return np.sort(np.asarray(keep, dtype=np.int64))
+
+
+def compute_edges(sample: GraphSample, radius, max_neighbours, periodic=False):
+    """Build edges on a sample in place, mirroring RadiusGraph / RadiusGraphPBC:
+    PBC also stores edge lengths in edge_attr (utils.py:118)."""
+    if periodic:
+        assert sample.supercell_size is not None, (
+            "The data must contain the size of the supercell to apply periodic "
+            "boundary conditions."
+        )
+        ei, lengths = periodic_radius_graph(
+            sample.pos, sample.supercell_size, radius, max_neighbours
+        )
+        sample.edge_index = ei
+        sample.edge_attr = lengths.reshape(-1, 1).astype(np.float32)
+    else:
+        ei, _ = radius_graph(sample.pos, radius, max_neighbours)
+        sample.edge_index = ei
+        sample.edge_attr = None
+    return sample
+
+
+def add_edge_lengths(sample: GraphSample) -> GraphSample:
+    """torch_geometric.transforms.Distance(norm=False, cat=True): append |p_r - p_s|
+    to edge_attr."""
+    ei = sample.edge_index
+    d = np.linalg.norm(
+        np.asarray(sample.pos)[ei[1]] - np.asarray(sample.pos)[ei[0]], axis=1
+    ).reshape(-1, 1).astype(np.float32)
+    if sample.edge_attr is None:
+        sample.edge_attr = d
+    else:
+        sample.edge_attr = np.concatenate(
+            [np.asarray(sample.edge_attr, dtype=np.float32), d], axis=1
+        )
+    return sample
+
+
+def normalize_rotation(sample: GraphSample) -> GraphSample:
+    """torch_geometric.transforms.NormalizeRotation(max_points=-1, sort=False):
+    rotate positions onto the eigenbasis of their covariance (centered)."""
+    pos = np.asarray(sample.pos, dtype=np.float64)
+    centered = pos - pos.mean(axis=0, keepdims=True)
+    cov = centered.T @ centered
+    _, eigvecs = np.linalg.eigh(cov)
+    sample.pos = (centered @ eigvecs).astype(pos.dtype)
+    return sample
+
+
+def check_if_graph_size_variable(*datasets) -> bool:
+    sizes = set()
+    for ds in datasets:
+        for s in ds:
+            sizes.add(s.num_nodes)
+            if len(sizes) > 1:
+                return True
+    return False

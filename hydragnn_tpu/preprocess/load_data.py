@@ -1,0 +1,122 @@
+"""Dataset loading & splitting orchestration
+(reference /root/reference/hydragnn/preprocess/load_data.py:34-183).
+
+Flow: raw→serialized conversion if paths are not .pkl (rank 0 + barrier) →
+"total"→train/val/test pkl split → per-split SerializedDataLoader →
+GraphDataLoader construction (sharded per process when running multi-process,
+replacing DistributedSampler)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Tuple
+
+from ..parallel.distributed import barrier, get_comm_size_and_rank
+from ..utils.time_utils import Timer
+from .dataloader import GraphDataLoader
+from .raw_loader import RawDataLoader
+from .serialized_loader import SerializedDataLoader
+from .splitting import split_dataset
+
+
+def dataset_loading_and_splitting(config: Dict):
+    if not list(config["Dataset"]["path"].values())[0].endswith(".pkl"):
+        transform_raw_data_to_serialized(config["Dataset"])
+    if "total" in config["Dataset"]["path"].keys():
+        total_to_train_val_test_pkls(config)
+    trainset, valset, testset = load_train_val_test_sets(config)
+    return create_dataloaders(
+        trainset,
+        valset,
+        testset,
+        batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
+    )
+
+
+def create_dataloaders(trainset, valset, testset, batch_size):
+    """Three GraphDataLoaders; multi-process runs shard every split by process
+    (the DistributedSampler analog). Returns (train, val, test, sampler_list) for
+    reference API parity — the loaders are their own samplers here."""
+    world_size, rank = get_comm_size_and_rank()
+    loaders = []
+    for ds, shuffle in ((trainset, True), (valset, True), (testset, True)):
+        loaders.append(
+            GraphDataLoader(
+                ds,
+                batch_size=batch_size,
+                shuffle=shuffle,
+                num_shards=world_size,
+                shard_rank=rank,
+            )
+        )
+    train_loader, val_loader, test_loader = loaders
+    sampler_list = loaders if world_size > 1 else []
+    return train_loader, val_loader, test_loader, sampler_list
+
+
+def load_train_val_test_sets(config: Dict):
+    timer = Timer("load_data")
+    timer.start()
+    dataset_list = []
+    datasetname_list = []
+    for dataset_name, raw_data_path in config["Dataset"]["path"].items():
+        if raw_data_path.endswith(".pkl"):
+            files_dir = raw_data_path
+        else:
+            files_dir = (
+                f"{os.environ['SERIALIZED_DATA_PATH']}/serialized_dataset/"
+                f"{config['Dataset']['name']}_{dataset_name}.pkl"
+            )
+        loader = SerializedDataLoader(config)
+        dataset_list.append(loader.load_serialized_data(dataset_path=files_dir))
+        datasetname_list.append(dataset_name)
+    trainset = dataset_list[datasetname_list.index("train")]
+    valset = dataset_list[datasetname_list.index("validate")]
+    testset = dataset_list[datasetname_list.index("test")]
+    timer.stop()
+    return trainset, valset, testset
+
+
+def transform_raw_data_to_serialized(dataset_config: Dict):
+    _, rank = get_comm_size_and_rank()
+    if rank == 0:
+        loader = RawDataLoader(dataset_config)
+        loader.load_raw_data()
+    barrier("raw_to_serialized")
+
+
+def total_to_train_val_test_pkls(config: Dict):
+    _, rank = get_comm_size_and_rank()
+    if list(config["Dataset"]["path"].values())[0].endswith(".pkl"):
+        file_dir = config["Dataset"]["path"]["total"]
+    else:
+        file_dir = (
+            f"{os.environ['SERIALIZED_DATA_PATH']}/serialized_dataset/"
+            f"{config['Dataset']['name']}.pkl"
+        )
+    with open(file_dir, "rb") as f:
+        minmax_node_feature = pickle.load(f)
+        minmax_graph_feature = pickle.load(f)
+        dataset_total = pickle.load(f)
+
+    trainset, valset, testset = split_dataset(
+        dataset=dataset_total,
+        perc_train=config["NeuralNetwork"]["Training"]["perc_train"],
+        stratify_splitting=config["Dataset"]["compositional_stratified_splitting"],
+    )
+    serialized_dir = os.path.dirname(file_dir)
+    config["Dataset"]["path"] = {}
+    for dataset_type, dataset in zip(
+        ["train", "validate", "test"], [trainset, valset, testset]
+    ):
+        serial_data_name = config["Dataset"]["name"] + "_" + dataset_type + ".pkl"
+        config["Dataset"]["path"][dataset_type] = (
+            serialized_dir + "/" + serial_data_name
+        )
+        if rank == 0:
+            with open(os.path.join(serialized_dir, serial_data_name), "wb") as f:
+                pickle.dump(minmax_node_feature, f)
+                pickle.dump(minmax_graph_feature, f)
+                pickle.dump(dataset, f)
+    barrier("total_split")

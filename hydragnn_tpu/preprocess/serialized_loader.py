@@ -1,0 +1,149 @@
+"""Serialized (pickled) dataset → training-ready GraphSamples
+(reference /root/reference/hydragnn/preprocess/serialized_dataset_loader.py:31-261).
+
+Pipeline per split: optional rotation normalization → radius-graph edges (flat or
+PBC) → edge lengths → GLOBAL max-edge-length normalization → target packing
+(update_predicted_values) → input-feature column selection → optional stratified
+subsample. One deliberate divergence: samples stay host-side numpy (the reference
+moves the whole dataset to the accelerator at load time,
+serialized_dataset_loader.py:137-140 — SURVEY.md §7 quirks list says stream
+instead, which our DataLoader does).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Sequence
+
+import numpy as np
+from sklearn.model_selection import StratifiedShuffleSplit
+
+from ..graphs.sample import GraphSample
+from .graph_build import add_edge_lengths, compute_edges, normalize_rotation
+
+
+class SerializedDataLoader:
+    def __init__(self, config: dict):
+        self.verbosity = config["Verbosity"]["level"]
+        ds = config["Dataset"]
+        self.node_feature_name = ds["node_features"]["name"]
+        self.node_feature_dim = ds["node_features"]["dim"]
+        self.node_feature_col = ds["node_features"]["column_index"]
+        self.graph_feature_name = ds["graph_features"]["name"]
+        self.graph_feature_dim = ds["graph_features"]["dim"]
+        self.graph_feature_col = ds["graph_features"]["column_index"]
+        self.rotational_invariance = ds["rotational_invariance"]
+        arch = config["NeuralNetwork"]["Architecture"]
+        self.periodic_boundary_conditions = arch["periodic_boundary_conditions"]
+        self.radius = arch["radius"]
+        self.max_neighbours = arch["max_neighbours"]
+        voi = config["NeuralNetwork"]["Variables_of_interest"]
+        self.variables = voi
+        self.variables_type = voi["type"]
+        self.output_index = voi["output_index"]
+        self.input_node_features = voi["input_node_features"]
+
+        assert len(self.node_feature_name) == len(self.node_feature_dim)
+        assert len(self.node_feature_name) == len(self.node_feature_col)
+        assert len(self.graph_feature_name) == len(self.graph_feature_dim)
+        assert len(self.graph_feature_name) == len(self.graph_feature_col)
+
+    def load_serialized_data(self, dataset_path: str) -> List[GraphSample]:
+        with open(dataset_path, "rb") as f:
+            _ = pickle.load(f)
+            _ = pickle.load(f)
+            dataset = pickle.load(f)
+
+        if self.rotational_invariance:
+            dataset = [normalize_rotation(s) for s in dataset]
+
+        for s in dataset:
+            compute_edges(
+                s,
+                self.radius,
+                self.max_neighbours,
+                periodic=self.periodic_boundary_conditions,
+            )
+            if not self.periodic_boundary_conditions:
+                # PBC already stored lengths in edge_attr.
+                add_edge_lengths(s)
+
+        # Global max-edge-length normalization across the split
+        # (serialized_dataset_loader.py:128-135).
+        max_edge_length = -np.inf
+        for s in dataset:
+            if s.edge_attr is not None and s.edge_attr.size:
+                max_edge_length = max(max_edge_length, float(s.edge_attr.max()))
+        if np.isfinite(max_edge_length) and max_edge_length > 0:
+            for s in dataset:
+                if s.edge_attr is not None:
+                    s.edge_attr = s.edge_attr / max_edge_length
+
+        for s in dataset:
+            update_predicted_values(
+                self.variables_type,
+                self.output_index,
+                self.graph_feature_dim,
+                self.node_feature_dim,
+                s,
+            )
+            s.x = s.x[:, list(self.input_node_features)]
+
+        if "subsample_percentage" in self.variables:
+            return stratified_subsample(
+                dataset, self.variables["subsample_percentage"]
+            )
+        return dataset
+
+
+def update_predicted_values(
+    type: Sequence[str],
+    index: Sequence[int],
+    graph_feature_dim: Sequence[int],
+    node_feature_dim: Sequence[int],
+    sample: GraphSample,
+) -> None:
+    """THE packed-y data contract (serialized_dataset_loader.py:220-261): y becomes
+    the concatenation of the selected per-head slices (graph slices then per-node
+    column slices, each flattened row-major); y_loc[0, i] is the prefix offset of
+    head i."""
+    output_feature = []
+    sample.y_loc = np.zeros((1, len(type) + 1), dtype=np.int64)
+    for item in range(len(type)):
+        if type[item] == "graph":
+            start = sum(graph_feature_dim[: index[item]])
+            feat = np.asarray(sample.y).reshape(-1)[
+                start : start + graph_feature_dim[index[item]]
+            ].reshape(-1, 1)
+        elif type[item] == "node":
+            start = sum(node_feature_dim[: index[item]])
+            feat = np.asarray(sample.x)[
+                :, start : start + node_feature_dim[index[item]]
+            ].reshape(-1, 1)
+        else:
+            raise ValueError("Unknown output type", type[item])
+        output_feature.append(feat)
+        sample.y_loc[0, item + 1] = sample.y_loc[0, item] + feat.shape[0]
+    sample.y = np.concatenate(output_feature, axis=0).astype(np.float32).reshape(-1)
+
+
+def composition_category(sample: GraphSample, base: int = 100) -> int:
+    """Category id = Σ sorted-frequency·base^rank over element frequencies
+    (serialized_dataset_loader.py:190-200)."""
+    freqs = np.bincount(np.asarray(sample.x[:, 0], dtype=np.int64))
+    freqs = sorted(int(f) for f in freqs if f > 0)
+    return sum(f * (base ** i) for i, f in enumerate(freqs))
+
+
+def stratified_subsample(
+    dataset: List[GraphSample], subsample_percentage: float
+) -> List[GraphSample]:
+    """Stratified (by composition category) subsample of the dataset
+    (serialized_dataset_loader.py:172-217)."""
+    categories = [composition_category(s) for s in dataset]
+    sss = StratifiedShuffleSplit(
+        n_splits=1, train_size=subsample_percentage, random_state=0
+    )
+    for keep_idx, _rest in sss.split(dataset, categories):
+        return [dataset[i] for i in keep_idx.tolist()]
+    return dataset

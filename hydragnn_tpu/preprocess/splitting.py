@@ -1,0 +1,95 @@
+"""Dataset splitting: sequential and compositional-stratified
+(reference /root/reference/hydragnn/preprocess/load_data.py:89-107 and
+compositional_data_splitting.py:26-152).
+
+The compositional category encodes the per-element atom counts of a structure as
+digits in base 10^ceil(log10(max_graph_size)), so each composition maps to a unique
+integer and sklearn's StratifiedShuffleSplit keeps all three splits
+composition-balanced. Singleton categories are duplicated first so sklearn can
+split them (the reference's "data augmentation" trick,
+compositional_data_splitting.py:75-90).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import List, Sequence, Tuple
+
+import numpy as np
+from sklearn.model_selection import StratifiedShuffleSplit
+
+from ..graphs.sample import GraphSample
+
+
+def get_max_graph_size(dataset: Sequence[GraphSample]) -> int:
+    return max(int(s.num_nodes) for s in dataset)
+
+
+def create_dataset_categories(dataset: Sequence[GraphSample]) -> List[int]:
+    max_graph_size = get_max_graph_size(dataset)
+    power_ten = math.ceil(math.log10(max_graph_size))
+    elements = sorted(
+        {float(e) for s in dataset for e in np.unique(np.asarray(s.x)[:, 0])}
+    )
+    element_rank = {e: i for i, e in enumerate(elements)}
+
+    categories = []
+    for s in dataset:
+        elems, freqs = np.unique(np.asarray(s.x)[:, 0], return_counts=True)
+        category = 0
+        for e, f in zip(elems, freqs):
+            category += int(f) * (10 ** (power_ten * element_rank[float(e)]))
+        categories.append(category)
+    return categories
+
+
+def duplicate_unique_data_samples(dataset, categories):
+    counter = collections.Counter(categories)
+    singletons = {k for k, v in counter.items() if v == 1}
+    extra, extra_cat = [], []
+    for s, c in zip(dataset, categories):
+        if c in singletons:
+            extra.append(s.clone())
+            extra_cat.append(c)
+    return list(dataset) + extra, list(categories) + extra_cat
+
+
+def _partition(dataset, categories, train_size):
+    sss = StratifiedShuffleSplit(n_splits=1, train_size=train_size, random_state=0)
+    for a_idx, b_idx in sss.split(dataset, categories):
+        return (
+            [dataset[i] for i in a_idx.tolist()],
+            [dataset[i] for i in b_idx.tolist()],
+        )
+
+
+def compositional_stratified_splitting(
+    dataset: Sequence[GraphSample], perc_train: float
+) -> Tuple[List[GraphSample], List[GraphSample], List[GraphSample]]:
+    categories = create_dataset_categories(dataset)
+    dataset, categories = duplicate_unique_data_samples(list(dataset), categories)
+    trainset, val_test = _partition(dataset, categories, perc_train)
+
+    vt_categories = create_dataset_categories(val_test)
+    val_test, vt_categories = duplicate_unique_data_samples(val_test, vt_categories)
+    valset, testset = _partition(val_test, vt_categories, 0.5)
+    return trainset, valset, testset
+
+
+def split_dataset(
+    dataset: Sequence[GraphSample], perc_train: float, stratify_splitting: bool
+):
+    """Sequential head/middle/tail split, or compositional stratified
+    (load_data.py:89-107)."""
+    if not stratify_splitting:
+        perc_val = (1 - perc_train) / 2
+        n = len(dataset)
+        trainset = dataset[: int(n * perc_train)]
+        valset = dataset[int(n * perc_train) : int(n * (perc_train + perc_val))]
+        testset = dataset[int(n * (perc_train + perc_val)) :]
+    else:
+        trainset, valset, testset = compositional_stratified_splitting(
+            dataset, perc_train
+        )
+    return trainset, valset, testset

@@ -1,0 +1,70 @@
+"""Prediction entry — ``hydragnn_tpu.run_prediction(config_or_path)``
+(reference /root/reference/hydragnn/run_prediction.py:27-80): data → model →
+restore checkpoint → test() → optional denormalize. Returns
+(error, error_rmse_task, true_values, predicted_values)."""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import singledispatch
+
+from .models.create import create_model_config, init_model_variables
+from .parallel.distributed import setup_ddp
+from .postprocess.postprocess import output_denormalize
+from .preprocess.load_data import dataset_loading_and_splitting
+from .train.train_validate_test import TrainingDriver
+from .train.trainer import create_train_state
+from .utils.config_utils import get_log_name_config, update_config
+from .utils.model import load_existing_model
+from .utils.optimizer import select_optimizer
+
+
+@singledispatch
+def run_prediction(config, mesh=None):
+    raise TypeError("Input must be filename string or configuration dictionary.")
+
+
+@run_prediction.register
+def _(config_file: str, mesh=None):
+    with open(config_file, "r") as f:
+        config = json.load(f)
+    return run_prediction(config, mesh=mesh)
+
+
+@run_prediction.register
+def _(config: dict, mesh=None):
+    os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+    setup_ddp()
+
+    train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
+        config=config
+    )
+    config = update_config(config, train_loader, val_loader, test_loader)
+
+    model = create_model_config(
+        config=config["NeuralNetwork"]["Architecture"],
+        verbosity=config["Verbosity"]["level"],
+    )
+    example = next(iter(test_loader))
+    variables = init_model_variables(model, example)
+
+    log_name = get_log_name_config(config)
+    variables, _ = load_existing_model(variables, log_name)
+
+    optimizer = select_optimizer("AdamW", 1e-3)  # unused for inference
+    state = create_train_state(model, variables, optimizer)
+    driver = TrainingDriver(
+        model, optimizer, state, mesh=mesh, verbosity=config["Verbosity"]["level"]
+    )
+    error, error_rmse_task, true_values, predicted_values = driver.evaluate(
+        test_loader, return_values=True
+    )
+
+    if config["NeuralNetwork"]["Variables_of_interest"]["denormalize_output"]:
+        true_values, predicted_values = output_denormalize(
+            config["NeuralNetwork"]["Variables_of_interest"]["y_minmax"],
+            true_values,
+            predicted_values,
+        )
+    return error, error_rmse_task, true_values, predicted_values

@@ -1,0 +1,133 @@
+"""High-level E2E training driver — ``hydragnn_tpu.run_training(config_or_path)``
+(reference /root/reference/hydragnn/run_training.py:40-122): env setup → process
+bootstrap → data load/split → config completion → model build → optimizer +
+ReduceLROnPlateau → log dir + config snapshot → optional warm start → epoch loop →
+rank-0 checkpoint → timer report."""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import singledispatch
+
+from .models.create import create_model_config, init_model_variables
+from .parallel.distributed import barrier, setup_ddp
+from .preprocess.load_data import dataset_loading_and_splitting
+from .train.train_validate_test import TrainingDriver, train_validate_test
+from .train.trainer import create_train_state
+from .utils.config_utils import get_log_name_config, update_config
+from .utils.model import (
+    get_summary_writer,
+    load_existing_model_config,
+    save_model,
+)
+from .utils.optimizer import ReduceLROnPlateau, select_optimizer
+from .utils.print_utils import print_distributed, setup_log
+from .utils.profile import Profiler
+from .utils.time_utils import print_timers
+
+
+@singledispatch
+def run_training(config, mesh=None):
+    raise TypeError("Input must be filename string or configuration dictionary.")
+
+
+@run_training.register
+def _(config_file: str, mesh=None):
+    with open(config_file, "r") as f:
+        config = json.load(f)
+    return run_training(config, mesh=mesh)
+
+
+@run_training.register
+def _(config: dict, mesh=None):
+    os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+
+    setup_log(get_log_name_config(config))
+    world_size, world_rank = setup_ddp()
+
+    verbosity = config["Verbosity"]["level"]
+    train_loader, val_loader, test_loader, sampler_list = (
+        dataset_loading_and_splitting(config=config)
+    )
+    config = update_config(config, train_loader, val_loader, test_loader)
+
+    model = create_model_config(
+        config=config["NeuralNetwork"]["Architecture"], verbosity=verbosity
+    )
+    example = next(iter(train_loader))
+    variables = init_model_variables(model, example)
+
+    optimizer = select_optimizer(
+        config["NeuralNetwork"]["Training"]["optimizer"],
+        config["NeuralNetwork"]["Training"]["learning_rate"],
+        freeze_conv=config["NeuralNetwork"]["Architecture"]["freeze_conv_layers"],
+    )
+    scheduler = ReduceLROnPlateau(factor=0.5, patience=5, min_lr=0.00001)
+
+    log_name = get_log_name_config(config)
+    writer = get_summary_writer(log_name)
+    barrier("logdir")
+    os.makedirs("./logs/" + log_name, exist_ok=True)
+    with open("./logs/" + log_name + "/config.json", "w") as f:
+        json.dump(config, f)
+
+    state = create_train_state(model, variables, optimizer)
+    # Warm start (Training.continue / startfrom).
+    new_vars, opt_state = load_existing_model_config(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        config["NeuralNetwork"]["Training"],
+        opt_state=state.opt_state,
+    )
+    state = state.replace(
+        params=new_vars["params"],
+        batch_stats=new_vars["batch_stats"],
+        opt_state=opt_state,
+    )
+
+    print_distributed(
+        verbosity,
+        "Starting training with the configuration: \n"
+        + json.dumps(config, indent=4, sort_keys=True),
+    )
+
+    profiler = Profiler("./logs/" + log_name)
+    profiler.setup(config.get("Profile"))
+
+    driver = TrainingDriver(
+        model, optimizer, state, mesh=mesh, verbosity=verbosity
+    )
+    history = train_validate_test(
+        driver,
+        train_loader,
+        val_loader,
+        test_loader,
+        config["NeuralNetwork"]["Training"]["num_epoch"],
+        writer=writer,
+        scheduler=scheduler,
+        profiler=profiler,
+        verbosity=verbosity,
+    )
+
+    if config["Visualization"].get("create_plots"):
+        from .postprocess.visualizer import Visualizer
+
+        _, _, true_values, predicted_values = driver.evaluate(
+            test_loader, return_values=True
+        )
+        viz = Visualizer(
+            "./logs/" + log_name,
+            node_feature=[],
+            num_heads=len(model.output_dim),
+            head_dims=list(model.output_dim),
+        )
+        viz.plot_history(history)
+        viz.create_parity_plots(true_values, predicted_values)
+
+    save_model(
+        {"params": driver.state.params, "batch_stats": driver.state.batch_stats},
+        driver.state.opt_state,
+        log_name,
+    )
+    print_timers(verbosity)
+    return history

@@ -1,0 +1,10 @@
+from .train_validate_test import TrainingDriver, train_validate_test
+from .trainer import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_eval_step_dp,
+    make_train_step,
+    make_train_step_dp,
+    stack_batches,
+)

@@ -1,0 +1,211 @@
+"""Epoch loop + train/validate/test
+(reference /root/reference/hydragnn/train/train_validate_test.py:32-304).
+
+Per epoch: loader.set_epoch (DP reshuffle) → train over all batches → validate →
+test → plateau-scheduler step on validation RMSE → TensorBoard scalars + history.
+Deviations from the reference, on purpose: eval metrics are reduced across all
+devices/processes (the reference reports per-rank-local averages, SURVEY.md §3.4),
+and the TensorBoard writer actually works (model.py:50-54 quirk)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..graphs.batch import GraphBatch
+from ..models.base import HydraGNN
+from ..utils.optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
+from ..utils.print_utils import iterate_tqdm, print_distributed
+from ..utils.profile import Profiler
+from ..utils.time_utils import Timer
+from .trainer import (
+    TrainState,
+    make_eval_step,
+    make_eval_step_dp,
+    make_train_step,
+    make_train_step_dp,
+    stack_batches,
+)
+
+
+class EpochMetrics:
+    """Graph-count-weighted averages accumulated over an epoch."""
+
+    def __init__(self):
+        self.loss = 0.0
+        self.rmses = None
+        self.count = 0.0
+
+    def update(self, metrics):
+        self.loss += float(metrics["loss"])
+        r = np.asarray(metrics["rmses"])
+        self.rmses = r if self.rmses is None else self.rmses + r
+        self.count += float(metrics["count"])
+
+    def averages(self):
+        c = max(self.count, 1.0)
+        return self.loss / c, (
+            (self.rmses / c).tolist() if self.rmses is not None else []
+        )
+
+
+class TrainingDriver:
+    """Owns the compiled steps + scheduler/profiler state for one model run."""
+
+    def __init__(
+        self,
+        model: HydraGNN,
+        optimizer,
+        state: TrainState,
+        mesh=None,
+        verbosity: int = 0,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.state = state
+        self.mesh = mesh
+        self.verbosity = verbosity
+        self.n_devices = 1
+        if mesh is not None:
+            self.n_devices = mesh.shape["data"]
+            self.train_step = make_train_step_dp(model, optimizer, mesh)
+            self.eval_step = make_eval_step_dp(model, mesh)
+        else:
+            self.train_step = make_train_step(model, optimizer)
+            self.eval_step = make_eval_step(model)
+        self.rng = jax.random.PRNGKey(0)
+
+    # ------------------------------------------------------------------ train
+    def _device_groups(self, loader):
+        """Lazily yield per-device batch groups stacked for shard_map."""
+        group = []
+        for b in loader:
+            group.append(b)
+            if len(group) == self.n_devices:
+                yield stack_batches(group, self.n_devices)
+                group = []
+        if group:
+            yield stack_batches(group, self.n_devices)
+
+    def train_epoch(self, loader, profiler: Optional[Profiler] = None):
+        metrics = EpochMetrics()
+        batches = (
+            self._device_groups(loader) if self.n_devices > 1 else iter(loader)
+        )
+        for batch in iterate_tqdm(batches, self.verbosity):
+            self.state, m = self.train_step(self.state, batch, self.rng)
+            metrics.update(m)
+            if profiler:
+                profiler.step()
+        return metrics.averages()
+
+    # ------------------------------------------------------------------- eval
+    def evaluate(self, loader, return_values: bool = False):
+        """validate()/test() analog. With return_values, also gathers per-head
+        (true, predicted) arrays over real rows (test(), reference
+        train_validate_test.py:267-304)."""
+        metrics = EpochMetrics()
+        num_heads = len(self.model.output_dim)
+        true_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
+        pred_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
+
+        def consume(batch_host: GraphBatch, outputs):
+            for ih, (htype, out) in enumerate(
+                zip(self.model.output_type, outputs)
+            ):
+                out = np.asarray(out)
+                if out.ndim == 3:  # DP: [D, rows, dim] → per-device slices
+                    out = out.reshape(-1, out.shape[-1])
+                mask = np.asarray(
+                    batch_host.graph_mask if htype == "graph" else batch_host.node_mask
+                ).reshape(-1)
+                tgt = np.asarray(batch_host.targets[ih]).reshape(-1, out.shape[-1])
+                pred_values[ih].append(out[mask])
+                true_values[ih].append(tgt[mask])
+
+        batches = (
+            self._device_groups(loader) if self.n_devices > 1 else iter(loader)
+        )
+        for batch in batches:
+            m, outputs = self.eval_step(self.state, batch)
+            metrics.update(m)
+            if return_values:
+                consume(batch, outputs)
+
+        loss, rmses = metrics.averages()
+        if return_values:
+            tv = [np.concatenate(v) if v else np.zeros((0, 1)) for v in true_values]
+            pv = [np.concatenate(v) if v else np.zeros((0, 1)) for v in pred_values]
+            return loss, rmses, tv, pv
+        return loss, rmses
+
+
+def train_validate_test(
+    driver: TrainingDriver,
+    train_loader,
+    val_loader,
+    test_loader,
+    num_epoch: int,
+    writer=None,
+    scheduler: Optional[ReduceLROnPlateau] = None,
+    profiler: Optional[Profiler] = None,
+    verbosity: int = 0,
+):
+    """The epoch loop (train_validate_test.py:94-137). Returns the loss history
+    dict consumed by the Visualizer."""
+    history = {
+        "total_loss_train": [],
+        "total_loss_val": [],
+        "total_loss_test": [],
+        "task_loss_train": [],
+        "task_loss_val": [],
+        "task_loss_test": [],
+    }
+    timer = Timer("train_validate_test")
+    timer.start()
+    for epoch in range(num_epoch):
+        for loader in (train_loader, val_loader, test_loader):
+            if hasattr(loader, "set_epoch"):
+                loader.set_epoch(epoch)
+        if profiler:
+            profiler.set_current_epoch(epoch)
+
+        train_loss, train_rmses = driver.train_epoch(train_loader, profiler)
+        val_loss, val_rmses = driver.evaluate(val_loader)
+        test_loss, test_rmses = driver.evaluate(test_loader)
+
+        if scheduler is not None:
+            current_lr = get_learning_rate(driver.state.opt_state)
+            new_lr = scheduler.step(val_loss, current_lr)
+            if new_lr != current_lr:
+                driver.state = driver.state.replace(
+                    opt_state=set_learning_rate(driver.state.opt_state, new_lr)
+                )
+                print_distributed(
+                    verbosity, f"Epoch {epoch}: learning rate reduced to {new_lr}"
+                )
+
+        if writer is not None:
+            writer.add_scalar("train error", train_loss, epoch)
+            writer.add_scalar("validate error", val_loss, epoch)
+            writer.add_scalar("test error", test_loss, epoch)
+            for ivar, rmse in enumerate(train_rmses):
+                writer.add_scalar(f"train error of task {ivar}", rmse, epoch)
+
+        print_distributed(
+            verbosity,
+            f"Epoch: {epoch:4d}  Train: {train_loss:.8f}  Val: {val_loss:.8f}  "
+            f"Test: {test_loss:.8f}",
+        )
+        history["total_loss_train"].append(train_loss)
+        history["total_loss_val"].append(val_loss)
+        history["total_loss_test"].append(test_loss)
+        history["task_loss_train"].append(train_rmses)
+        history["task_loss_val"].append(val_rmses)
+        history["task_loss_test"].append(test_rmses)
+    if profiler:
+        profiler.stop()
+    timer.stop()
+    return history

@@ -1,0 +1,212 @@
+"""Compiled train/eval steps. THE distribution contract (SURVEY.md §7 pillar 2):
+there is no DDP wrapper object — data parallelism is a psum inside the
+shard_map-compiled step over the 'data' mesh axis, replacing the reference's
+DistributedDataParallel + NCCL allreduce (/root/reference/hydragnn/utils/
+distributed.py:216-226, gradient sync at train_validate_test.py:231).
+
+Two step flavors:
+  * make_train_step(model, opt)            — single-device jit.
+  * make_train_step_dp(model, opt, mesh)   — batch stacked [D, ...] over the
+    'data' axis; grads/metrics psum'd over ICI. Eval metrics are also reduced
+    (fixing the reference's per-rank-only eval metrics, SURVEY.md §3.4).
+
+Metrics are returned as (weighted sum, count) pairs so the host can form
+graph-count-weighted epoch averages exactly like the reference's
+loss.item()*num_graphs accumulation (train_validate_test.py:234-237).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax.sharding import PartitionSpec as P
+
+from ..graphs.batch import GraphBatch
+from ..models.base import HydraGNN
+from ..models.loss import multihead_rmse_loss
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def create_train_state(model, variables, optimizer) -> TrainState:
+    return TrainState(
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=optimizer.init(variables["params"]),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def _loss_and_metrics(model: HydraGNN, params, batch_stats, batch, dropout_key):
+    outputs, mut = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        batch,
+        train=True,
+        mutable=["batch_stats"],
+        rngs={"dropout": dropout_key},
+    )
+    loss, rmses = multihead_rmse_loss(
+        outputs, batch, model.output_type, model.task_weights
+    )
+    return loss, (mut["batch_stats"], rmses)
+
+
+def make_train_step(model: HydraGNN, optimizer) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: GraphBatch, rng):
+        dropout_key = jax.random.fold_in(rng, state.step)
+        grad_fn = jax.value_and_grad(
+            lambda p: _loss_and_metrics(model, p, state.batch_stats, batch, dropout_key),
+            has_aux=True,
+        )
+        (loss, (new_bstats, rmses)), grads = grad_fn(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p + u, state.params, updates
+        )
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_bstats,
+            opt_state=new_opt,
+            step=state.step + 1,
+        )
+        count = batch.count_real_graphs().astype(jnp.float32)
+        return new_state, {"loss": loss * count, "rmses": rmses * count, "count": count}
+
+    return step
+
+
+def make_eval_step(model: HydraGNN) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: GraphBatch):
+        outputs = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch,
+            train=False,
+        )
+        loss, rmses = multihead_rmse_loss(
+            outputs, batch, model.output_type, model.task_weights
+        )
+        count = batch.count_real_graphs().astype(jnp.float32)
+        return (
+            {"loss": loss * count, "rmses": rmses * count, "count": count},
+            outputs,
+        )
+
+    return step
+
+
+# --------------------------------------------------------------------------- DP
+def _batch_pspec(batch: GraphBatch) -> GraphBatch:
+    """PartitionSpec tree: every array sharded on its leading (device) axis."""
+    return jax.tree_util.tree_map(lambda _: P("data"), batch)
+
+
+def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
+    """Data-parallel step. ``batch`` arrays carry a leading device axis [D, ...];
+    each device runs local message passing on its shard, then grads and metrics
+    are psum'd over 'data' (the DDP-allreduce analog, over ICI)."""
+    from jax.experimental.shard_map import shard_map
+
+    def _local(state, batch, rng):
+        # Inside shard_map the leading device axis is size 1: drop it.
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        dropout_key = jax.random.fold_in(
+            rng, state.step * 1000 + jax.lax.axis_index("data")
+        )
+        grad_fn = jax.value_and_grad(
+            lambda p: _loss_and_metrics(model, p, state.batch_stats, batch, dropout_key),
+            has_aux=True,
+        )
+        (loss, (new_bstats, rmses)), grads = grad_fn(state.params)
+        count = batch.count_real_graphs().astype(jnp.float32)
+        # Gradient allreduce (mean over devices), like DDP.
+        grads = jax.lax.pmean(grads, "data")
+        # Batch-stats allreduce keeps running statistics replicated.
+        new_bstats = jax.lax.pmean(new_bstats, "data")
+        loss_sum = jax.lax.psum(loss * count, "data")
+        rmses_sum = jax.lax.psum(rmses * count, "data")
+        count_sum = jax.lax.psum(count, "data")
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_bstats,
+            opt_state=new_opt,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss_sum, "rmses": rmses_sum, "count": count_sum}
+
+    def step(state, batch, rng):
+        sharded = shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(), _batch_pspec(batch), P()),
+            out_specs=(P(), P()),
+            check_rep=False,
+        )
+        return sharded(state, batch, rng)
+
+    return jax.jit(step)
+
+
+def make_eval_step_dp(model: HydraGNN, mesh) -> Callable:
+    from jax.experimental.shard_map import shard_map
+
+    def _local(state, batch):
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        outputs = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch,
+            train=False,
+        )
+        loss, rmses = multihead_rmse_loss(
+            outputs, batch, model.output_type, model.task_weights
+        )
+        count = batch.count_real_graphs().astype(jnp.float32)
+        metrics = {
+            "loss": jax.lax.psum(loss * count, "data"),
+            "rmses": jax.lax.psum(rmses * count, "data"),
+            "count": jax.lax.psum(count, "data"),
+        }
+        outputs = [o[None] for o in outputs]  # restore device axis for gather
+        return metrics, outputs
+
+    def step(state, batch):
+        sharded = shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(), _batch_pspec(batch)),
+            out_specs=(P(), [P("data") for _ in model.output_dim]),
+            check_rep=False,
+        )
+        return sharded(state, batch)
+
+    return jax.jit(step)
+
+
+def stack_batches(batches: Sequence[GraphBatch], n_devices: int) -> GraphBatch:
+    """Stack per-device GraphBatches along a new leading axis, padding the tail
+    with empty (all-masked) batches so every device has work every step."""
+    batches = list(batches)
+    template = batches[0]
+    while len(batches) < n_devices:
+        empty = jax.tree_util.tree_map(lambda x: np.zeros_like(x), template)
+        empty = empty.replace(
+            senders=np.full_like(template.senders, template.num_nodes_pad - 1),
+            receivers=np.full_like(template.receivers, template.num_nodes_pad - 1),
+            node_graph=np.full_like(template.node_graph, template.num_graphs_pad - 1),
+        )
+        batches.append(empty)
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)

@@ -1,0 +1,12 @@
+from .config_utils import get_log_name_config, update_config, update_config_minmax
+from .model import (
+    calculate_PNA_degree,
+    get_summary_writer,
+    load_existing_model,
+    load_existing_model_config,
+    save_model,
+)
+from .optimizer import ReduceLROnPlateau, select_optimizer
+from .print_utils import iterate_tqdm, log, print_distributed, setup_log
+from .profile import Profiler
+from .time_utils import Timer, print_timers

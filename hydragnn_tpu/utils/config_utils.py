@@ -1,0 +1,187 @@
+"""Config system: JSON schema identical to the reference, including data-driven
+completion (reference /root/reference/hydragnn/utils/config_utils.py:17-195).
+
+``update_config`` fills Architecture fields from the first training sample:
+output_dim/output_type from the packed y_loc, input_dim from selected features,
+the PNA degree histogram from the train set, edge_dim validation, and defaults —
+then pushes the inferred head spec into the data loaders (which need it to emit
+per-head dense targets)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+from ..preprocess.graph_build import check_if_graph_size_variable
+from .model import calculate_PNA_degree
+
+
+def update_config(config: Dict[str, Any], train_loader, val_loader, test_loader):
+    graph_size_variable = check_if_graph_size_variable(
+        train_loader.dataset, val_loader.dataset, test_loader.dataset
+    )
+
+    if "Dataset" in config:
+        check_output_dim_consistent(train_loader.dataset[0], config)
+
+    config["NeuralNetwork"] = update_config_NN_outputs(
+        config["NeuralNetwork"], train_loader.dataset[0], graph_size_variable
+    )
+    config = normalize_output_config(config)
+
+    arch = config["NeuralNetwork"]["Architecture"]
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    arch["input_dim"] = len(voi["input_node_features"])
+
+    if arch["model_type"] == "PNA":
+        deg = calculate_PNA_degree(train_loader.dataset, arch["max_neighbours"])
+        arch["pna_deg"] = deg.tolist()
+    else:
+        arch["pna_deg"] = None
+
+    config["NeuralNetwork"]["Architecture"] = update_config_edge_dim(arch)
+
+    arch.setdefault("freeze_conv_layers", False)
+    arch.setdefault("initial_bias", None)
+    config["NeuralNetwork"]["Training"].setdefault("optimizer", "AdamW")
+
+    # Push the inferred head spec into the loaders so batches carry targets.
+    for loader in (train_loader, val_loader, test_loader):
+        loader.set_head_spec(arch["output_type"], arch["output_dim"])
+        loader.edge_dim = arch["edge_dim"]
+
+    return config
+
+
+def update_config_edge_dim(arch: Dict[str, Any]) -> Dict[str, Any]:
+    arch["edge_dim"] = None
+    edge_models = ["PNA", "CGCNN"]
+    if "edge_features" in arch and arch["edge_features"]:
+        assert (
+            arch["model_type"] in edge_models
+        ), "Edge features can only be used with PNA and CGCNN."
+        arch["edge_dim"] = len(arch["edge_features"])
+    elif arch["model_type"] == "CGCNN":
+        # CGCNN always needs an integer edge_dim (config_utils.py:68-71).
+        arch["edge_dim"] = 0
+    return arch
+
+
+def check_output_dim_consistent(data, config: Dict[str, Any]) -> None:
+    output_type = config["NeuralNetwork"]["Variables_of_interest"]["type"]
+    output_index = config["NeuralNetwork"]["Variables_of_interest"]["output_index"]
+    for ihead in range(len(output_type)):
+        span = int(data.y_loc[0, ihead + 1]) - int(data.y_loc[0, ihead])
+        if output_type[ihead] == "graph":
+            assert (
+                span
+                == config["Dataset"]["graph_features"]["dim"][output_index[ihead]]
+            )
+        elif output_type[ihead] == "node":
+            assert (
+                span // data.num_nodes
+                == config["Dataset"]["node_features"]["dim"][output_index[ihead]]
+            )
+
+
+def update_config_NN_outputs(
+    nn_config: Dict[str, Any], data, graph_size_variable: bool
+) -> Dict[str, Any]:
+    output_type = nn_config["Variables_of_interest"]["type"]
+    dims_list = []
+    for ihead in range(len(output_type)):
+        span = int(data.y_loc[0, ihead + 1]) - int(data.y_loc[0, ihead])
+        if output_type[ihead] == "graph":
+            dim_item = span
+        elif output_type[ihead] == "node":
+            if (
+                graph_size_variable
+                and nn_config["Architecture"]["output_heads"]["node"]["type"]
+                == "mlp_per_node"
+            ):
+                raise ValueError(
+                    '"mlp_per_node" is not allowed for variable graph size, Please '
+                    'set config["NeuralNetwork"]["Architecture"]["output_heads"]'
+                    '["node"]["type"] to be "mlp" or "conv" in input file.'
+                )
+            dim_item = span // data.num_nodes
+        else:
+            raise ValueError("Unknown output type", output_type[ihead])
+        dims_list.append(dim_item)
+    nn_config["Architecture"]["output_dim"] = dims_list
+    nn_config["Architecture"]["output_type"] = output_type
+    nn_config["Architecture"]["num_nodes"] = data.num_nodes
+    return nn_config
+
+
+def normalize_output_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    var_config = config["NeuralNetwork"]["Variables_of_interest"]
+    if var_config.get("denormalize_output"):
+        if list(config["Dataset"]["path"].values())[0].endswith(".pkl"):
+            dataset_path = list(config["Dataset"]["path"].values())[0]
+        else:
+            base = os.environ["SERIALIZED_DATA_PATH"]
+            if "total" in config["Dataset"]["path"]:
+                dataset_path = (
+                    f"{base}/serialized_dataset/{config['Dataset']['name']}.pkl"
+                )
+            else:
+                dataset_path = (
+                    f"{base}/serialized_dataset/{config['Dataset']['name']}_train.pkl"
+                )
+        var_config = update_config_minmax(dataset_path, var_config)
+    else:
+        var_config["denormalize_output"] = False
+    config["NeuralNetwork"]["Variables_of_interest"] = var_config
+    return config
+
+
+def update_config_minmax(dataset_path: str, config: Dict[str, Any]):
+    """Load per-feature min/max tables pickled ahead of the dataset
+    (config_utils.py:142-161)."""
+    with open(dataset_path, "rb") as f:
+        node_minmax = pickle.load(f)
+        graph_minmax = pickle.load(f)
+    config["x_minmax"] = []
+    config["y_minmax"] = []
+    for item in config["input_node_features"]:
+        config["x_minmax"].append(node_minmax[:, item].tolist())
+    for out_type, out_index in zip(config["type"], config["output_index"]):
+        if out_type == "graph":
+            config["y_minmax"].append(graph_minmax[:, out_index].tolist())
+        elif out_type == "node":
+            config["y_minmax"].append(node_minmax[:, out_index].tolist())
+        else:
+            raise ValueError("Unknown output type", out_type)
+    return config
+
+
+def get_log_name_config(config: Dict[str, Any]) -> str:
+    """Hyperparameter-encoding log/checkpoint name (config_utils.py:164-195)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    train = config["NeuralNetwork"]["Training"]
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    return (
+        arch["model_type"]
+        + "-r-"
+        + str(arch["radius"])
+        + "-mnnn-"
+        + str(arch["max_neighbours"])
+        + "-ncl-"
+        + str(arch["num_conv_layers"])
+        + "-hd-"
+        + str(arch["hidden_dim"])
+        + "-ne-"
+        + str(train["num_epoch"])
+        + "-lr-"
+        + str(train["learning_rate"])
+        + "-bs-"
+        + str(train["batch_size"])
+        + "-data-"
+        + config["Dataset"]["name"]
+        + "-node_ft-"
+        + "".join(str(x) for x in voi["input_node_features"])
+        + "-task_weights-"
+        + "".join(str(w) + "-" for w in arch["task_weights"])
+    )

@@ -1,0 +1,120 @@
+"""Checkpointing, TensorBoard, and model statistics
+(reference /root/reference/hydragnn/utils/model.py:28-97).
+
+Checkpoint format: single file ``./logs/<name>/<name>.pk`` holding msgpack-encoded
+{params, batch_stats, opt_state} via flax.serialization — same single-file,
+rank-0-only semantics as the reference's torch.save of
+{model_state_dict, optimizer_state_dict}. Improvement over reference (documented
+divergence, SURVEY.md §5.4): ``save_model`` can be called periodically, and
+``get_summary_writer`` actually RETURNS the writer (the reference's returns None,
+leaving its TensorBoard path dead — model.py:50-54)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+from .print_utils import print_distributed
+
+
+def _is_rank_zero() -> bool:
+    return jax.process_index() == 0
+
+
+def save_model(
+    variables: Dict[str, Any],
+    opt_state: Any,
+    name: str,
+    path: str = "./logs/",
+) -> None:
+    """Rank-0 single-file checkpoint (model.py:35-47)."""
+    if not _is_rank_zero():
+        return
+    path_name = os.path.join(path, name, name + ".pk")
+    payload = {
+        "params": serialization.to_bytes(variables["params"]),
+        "batch_stats": serialization.to_bytes(variables.get("batch_stats", {})),
+        "opt_state": serialization.to_bytes(opt_state)
+        if opt_state is not None
+        else None,
+    }
+    os.makedirs(os.path.dirname(path_name), exist_ok=True)
+    with open(path_name, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_existing_model(
+    variables: Dict[str, Any],
+    model_name: str,
+    path: str = "./logs/",
+    opt_state: Any = None,
+):
+    """Restore params/batch_stats (+optimizer state if given a template) from the
+    single-file checkpoint (model.py:63-78). Returns (variables, opt_state)."""
+    path_name = os.path.join(path, model_name, model_name + ".pk")
+    with open(path_name, "rb") as f:
+        payload = pickle.load(f)
+    params = serialization.from_bytes(variables["params"], payload["params"])
+    bstats = serialization.from_bytes(
+        variables.get("batch_stats", {}), payload["batch_stats"]
+    )
+    new_vars = dict(variables)
+    new_vars["params"] = params
+    new_vars["batch_stats"] = bstats
+    if opt_state is not None and payload.get("opt_state") is not None:
+        opt_state = serialization.from_bytes(opt_state, payload["opt_state"])
+    return new_vars, opt_state
+
+
+def load_existing_model_config(
+    variables, config: Dict[str, Any], path: str = "./logs/", opt_state: Any = None
+):
+    """Warm start when Training.continue is set (model.py:57-60)."""
+    if config.get("continue", 0):
+        model_name = config.get("startfrom", "existing_model")
+        return load_existing_model(variables, model_name, path, opt_state)
+    return variables, opt_state
+
+
+def checkpoint_exists(model_name: str, path: str = "./logs/") -> bool:
+    return os.path.exists(os.path.join(path, model_name, model_name + ".pk"))
+
+
+def get_summary_writer(name: str, path: str = "./logs/"):
+    """Rank-0 TensorBoard writer — actually returned, unlike the reference
+    (model.py:50-54 returns None and the TB path is dead)."""
+    if not _is_rank_zero():
+        return None
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+    except Exception:
+        return None
+    return SummaryWriter(os.path.join(path, name))
+
+
+def calculate_PNA_degree(dataset, max_neighbours: int) -> np.ndarray:
+    """In-degree histogram over the train set for PNA scalers
+    (model.py:81-86)."""
+    hist = np.zeros(max_neighbours + 1, dtype=np.int64)
+    for s in dataset:
+        deg = np.bincount(
+            np.asarray(s.edge_index[1], dtype=np.int64), minlength=s.num_nodes
+        )
+        hist += np.bincount(
+            np.clip(deg, 0, max_neighbours), minlength=max_neighbours + 1
+        )
+    return hist
+
+
+def count_parameters(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def print_model(model, params, verbosity: int = 0) -> None:
+    print_distributed(verbosity, str(model))
+    print_distributed(verbosity, f"Total parameters: {count_parameters(params)}")

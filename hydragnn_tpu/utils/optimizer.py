@@ -1,0 +1,116 @@
+"""Optimizer selection (reference /root/reference/hydragnn/utils/optimizer.py:4-30):
+the same name set, mapped to optax. Learning rate is the only exposed knob, like
+the reference. The LR is injected as a mutable hyperparameter so the
+ReduceLROnPlateau scheduler can update it between epochs without rebuilding
+optimizer state. ``freeze_conv`` applies an optax mask (no update at all for
+encoder conv/bn params — the functional analog of requires_grad=False,
+reference Base._freeze_conv, Base.py:107-111)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def _base_optimizer(name: str, learning_rate: float):
+    name_l = name.lower()
+    table = {
+        "sgd": lambda lr: optax.sgd(lr),
+        "adam": lambda lr: optax.adam(lr),
+        "adadelta": lambda lr: optax.adadelta(lr),
+        "adagrad": lambda lr: optax.adagrad(lr),
+        "adamax": lambda lr: optax.adamax(lr),
+        "adamw": lambda lr: optax.adamw(lr),
+        "rmsprop": lambda lr: optax.rmsprop(lr),
+        # torch SparseAdam is Adam with sparse-gradient support; dense here.
+        "sparseadam": lambda lr: optax.adam(lr),
+        "lbfgs": lambda lr: optax.lbfgs(lr),
+    }
+    if name_l not in table:
+        raise ValueError(f"Purpose of {name} optimizer is not defined.")
+    return table[name_l](learning_rate)
+
+
+def select_optimizer(
+    name: str,
+    learning_rate: float,
+    freeze_conv: bool = False,
+) -> optax.GradientTransformation:
+    opt = optax.inject_hyperparams(
+        lambda learning_rate: _base_optimizer(name, learning_rate)
+    )(learning_rate=learning_rate)
+    if freeze_conv:
+        opt = optax.multi_transform(
+            {"train": opt, "frozen": optax.set_to_zero()},
+            _freeze_partition,
+        )
+    return opt
+
+
+def _freeze_partition(params):
+    """Label encoder conv/bn params 'frozen', everything else 'train'."""
+    import jax
+
+    def label(path, _):
+        top = path[0].key if hasattr(path[0], "key") else str(path[0])
+        frozen = top.startswith("conv_") or top.startswith("bn_")
+        return "frozen" if frozen else "train"
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def get_learning_rate(opt_state) -> Optional[float]:
+    """Current injected LR (walks multi_transform wrapping if present)."""
+    state = opt_state
+    if hasattr(state, "inner_states"):  # multi_transform
+        state = state.inner_states["train"].inner_state
+    if hasattr(state, "hyperparams"):
+        return float(state.hyperparams["learning_rate"])
+    return None
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Return opt_state with the injected LR replaced (host-side scheduler hook)."""
+    import jax.numpy as jnp
+
+    if hasattr(opt_state, "inner_states"):
+        inner = opt_state.inner_states["train"]
+        new_inner_state = set_learning_rate(inner.inner_state, lr)
+        new_inner = inner._replace(inner_state=new_inner_state)
+        states = dict(opt_state.inner_states)
+        states["train"] = new_inner
+        return opt_state._replace(inner_states=states)
+    if hasattr(opt_state, "hyperparams"):
+        hp = dict(opt_state.hyperparams)
+        hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.asarray(hp["learning_rate"]).dtype)
+        return opt_state._replace(hyperparams=hp)
+    raise ValueError("Optimizer state does not carry an injected learning rate")
+
+
+class ReduceLROnPlateau:
+    """Host-side plateau scheduler (reference run_training.py:82-84: factor 0.5,
+    patience 5, min_lr 1e-5; stepped on validation RMSE every epoch)."""
+
+    def __init__(self, factor=0.5, patience=5, min_lr=1e-5, mode="min"):
+        self.factor = factor
+        self.patience = patience
+        self.min_lr = min_lr
+        self.mode = mode
+        self.best = None
+        self.num_bad_epochs = 0
+
+    def step(self, metric: float, current_lr: float) -> float:
+        """Returns the (possibly reduced) learning rate."""
+        better = self.best is None or (
+            metric < self.best if self.mode == "min" else metric > self.best
+        )
+        if better:
+            self.best = metric
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            self.num_bad_epochs = 0
+            return max(current_lr * self.factor, self.min_lr)
+        return current_lr

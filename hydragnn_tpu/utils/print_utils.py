@@ -1,0 +1,72 @@
+"""Verbosity-gated logging (reference /root/reference/hydragnn/utils/print_utils.py:20-103).
+
+Levels: 0 = silent, 1-2 = rank 0 only, 3-4 = all ranks; 2 and 4 add tqdm bars.
+``iterate_tqdm`` guards the uninitialized-distributed case the reference crashes
+on (print_utils.py:57 quirk, SURVEY.md §7)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterable
+
+import jax
+
+
+def _rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_distributed(verbosity: int, *args) -> None:
+    if verbosity in (1, 2):
+        if _rank() == 0:
+            print(*args, flush=True)
+    elif verbosity in (3, 4):
+        print(f"[rank {_rank()}]", *args, flush=True)
+
+
+def iterate_tqdm(iterable: Iterable, verbosity: int):
+    show = verbosity in (2, 4) and (_rank() == 0 or verbosity == 4)
+    if show:
+        try:
+            from tqdm import tqdm
+
+            return tqdm(iterable)
+        except ImportError:
+            pass
+    return iterable
+
+
+_logger = None
+
+
+def setup_log(log_name: str, log_dir: str = "./logs") -> logging.Logger:
+    """File+console logger under ./logs/<name>/run.log, rank-prefixed messages
+    (print_utils.py:63-103)."""
+    global _logger
+    path = os.path.join(log_dir, log_name)
+    os.makedirs(path, exist_ok=True)
+    logger = logging.getLogger("hydragnn")
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    fmt = logging.Formatter(f"[rank {_rank()}] %(message)s")
+    fh = logging.FileHandler(os.path.join(path, "run.log"))
+    fh.setFormatter(fmt)
+    sh = logging.StreamHandler()
+    sh.setFormatter(fmt)
+    logger.addHandler(fh)
+    logger.addHandler(sh)
+    _logger = logger
+    return logger
+
+
+def log(*args) -> None:
+    if _logger is not None:
+        _logger.info(" ".join(str(a) for a in args))
+
+
+def get_log_dir(log_name: str, log_dir: str = "./logs") -> str:
+    return os.path.join(log_dir, log_name)

@@ -1,0 +1,53 @@
+"""Epoch-targeted profiler window (reference /root/reference/hydragnn/utils/
+profile.py:9-68 wraps torch.profiler; here jax.profiler traces to TensorBoard).
+
+Config surface is identical: ``"Profile": {"enable": 1, "target_epoch": N}``; the
+trace covers the target epoch's train loop and lands under
+./logs/<name>/profiler_output for TensorBoard / Perfetto."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+
+class Profiler:
+    def __init__(self, prefix: str = "./logs/profile"):
+        self.enabled = False
+        self.target_epoch: Optional[int] = None
+        self.trace_dir = os.path.join(prefix, "profiler_output")
+        self._active = False
+
+    def setup(self, config: Optional[dict]) -> None:
+        """config = the optional "Profile" block of the run config."""
+        if not config:
+            return
+        self.enabled = bool(config.get("enable", 0))
+        self.target_epoch = config.get("target_epoch", 0)
+
+    def set_current_epoch(self, epoch: int) -> None:
+        if not self.enabled:
+            return
+        if epoch == self.target_epoch and not self._active:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        elif self._active and epoch != self.target_epoch:
+            self.stop()
+
+    def step(self) -> None:
+        """Per-batch hook kept for API parity (jax traces need no step marker)."""
+
+    def annotate(self, name: str):
+        """Named span (record_function analog) inside the trace."""
+        if self._active:
+            return jax.profiler.TraceAnnotation(name)
+        return contextlib.nullcontext()
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

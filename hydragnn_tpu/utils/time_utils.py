@@ -1,0 +1,87 @@
+"""Named wall-clock timers with cross-process min/max/avg reduction
+(reference /root/reference/hydragnn/utils/time_utils.py:22-138).
+
+Timers are host-side (they time host-visible phases: data load, model create,
+whole training). Under multi-process JAX the reduction uses a tiny psum'd
+all-gather via multihost_utils instead of torch.distributed reduce."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+
+
+class Timer:
+    """Accumulating named timer; class-level registry like the reference."""
+
+    _totals: Dict[str, float] = {}
+    _counts: Dict[str, int] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = None
+
+    def start(self):
+        if self._start is not None:
+            raise RuntimeError(f"Timer {self.name} already started")
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is None:
+            raise RuntimeError(f"Timer {self.name} not started")
+        elapsed = time.perf_counter() - self._start
+        Timer._totals[self.name] = Timer._totals.get(self.name, 0.0) + elapsed
+        Timer._counts[self.name] = Timer._counts.get(self.name, 0) + 1
+        self._start = None
+        return elapsed
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    @classmethod
+    def reset(cls):
+        cls._totals.clear()
+        cls._counts.clear()
+
+
+def reduce_timers() -> Dict[str, Dict[str, float]]:
+    """Per-timer min/max/avg across processes (rank-0 meaningful)."""
+    stats = {}
+    nproc = jax.process_count()
+    for name, total in Timer._totals.items():
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+            import numpy as np
+
+            gathered = multihost_utils.process_allgather(np.float64(total))
+            stats[name] = {
+                "min": float(gathered.min()),
+                "max": float(gathered.max()),
+                "avg": float(gathered.mean()),
+            }
+        else:
+            stats[name] = {"min": total, "max": total, "avg": total}
+    return stats
+
+
+def print_timers(verbosity: int = 0):
+    """Sorted-by-cost timer report at end of run (time_utils.py:95-138)."""
+    from .print_utils import print_distributed
+
+    stats = reduce_timers()
+    if not stats:
+        return
+    width = max(len(n) for n in stats)
+    lines = ["Timer report (seconds):"]
+    for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["max"]):
+        lines.append(
+            f"  {name:<{width}}  min={s['min']:.3f}  max={s['max']:.3f}  "
+            f"avg={s['avg']:.3f}"
+        )
+    print_distributed(verbosity, "\n".join(lines))

@@ -1,0 +1,114 @@
+"""Synthetic deterministic BCC-lattice dataset generator, written as LSMS-format
+text files so the whole raw→serialized→train pipeline is exercised
+(reference /root/reference/tests/deterministic_graph_data.py:20-173).
+
+Data contract per file:
+  line 0:  GLOBAL_OUTPUT [GLOBAL_OUTPUT_LINEAR]
+  line i:  FEATURE  INDEX  X  Y  Z  OUT1  OUT2  OUT3
+with FEATURE = random type id, OUT1 = knn-smoothed feature (message-passing
+surrogate), OUT2 = OUT1², OUT3 = OUT1³, GLOBAL = Σ(OUT1)+Σ(OUT2)+Σ(OUT3).
+Unlike the reference (unseeded torch.randint) generation is seeded per
+configuration, so regenerated datasets are reproducible."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from sklearn.neighbors import KNeighborsRegressor
+
+
+def deterministic_graph_data(
+    path: str,
+    number_configurations: int = 500,
+    configuration_start: int = 0,
+    unit_cell_x_range=(1, 3),
+    unit_cell_y_range=(1, 3),
+    unit_cell_z_range=(1, 2),
+    number_types: int = 3,
+    types=None,
+    number_neighbors: int = 2,
+    linear_only: bool = False,
+):
+    if types is None:
+        types = list(range(number_types))
+    # Distinct streams per split directory (train/test/validate must differ).
+    path_salt = sum(ord(c) for c in os.path.basename(os.path.normpath(path)))
+    for configuration in range(number_configurations):
+        rng = np.random.default_rng(
+            12345 + 1000 * path_salt + configuration + configuration_start
+        )
+        uc_x = int(rng.integers(unit_cell_x_range[0], unit_cell_x_range[1]))
+        uc_y = int(rng.integers(unit_cell_y_range[0], unit_cell_y_range[1]))
+        uc_z = int(rng.integers(unit_cell_z_range[0], unit_cell_z_range[1]))
+        _create_configuration(
+            path,
+            configuration,
+            configuration_start,
+            uc_x,
+            uc_y,
+            uc_z,
+            types,
+            number_neighbors,
+            linear_only,
+            rng,
+        )
+
+
+def _create_configuration(
+    path,
+    configuration,
+    configuration_start,
+    uc_x,
+    uc_y,
+    uc_z,
+    types,
+    number_neighbors,
+    linear_only,
+    rng,
+):
+    number_nodes = 2 * uc_x * uc_y * uc_z
+    positions = np.zeros((number_nodes, 3))
+    count = 0
+    # Body-centered cubic: corner + center atom per unit cell.
+    for x in range(uc_x):
+        for y in range(uc_y):
+            for z in range(uc_z):
+                positions[count] = (x, y, z)
+                positions[count + 1] = (x + 0.5, y + 0.5, z + 0.5)
+                count += 2
+
+    node_ids = np.arange(number_nodes).reshape(-1, 1)
+    node_feature = rng.integers(
+        min(types), max(types) + 1, size=(number_nodes, 1)
+    ).astype(np.float64)
+
+    if linear_only:
+        node_output_x = node_feature
+    else:
+        knn = KNeighborsRegressor(number_neighbors)
+        knn.fit(positions, node_feature)
+        node_output_x = knn.predict(positions).reshape(-1, 1)
+
+    out_sq = node_output_x**2
+    out_cube = node_output_x**3
+
+    if linear_only:
+        total_line = f"{float(node_output_x.sum()):.8f}"
+    else:
+        total = float(node_output_x.sum() + out_sq.sum() + out_cube.sum())
+        total_linear = float(node_output_x.sum())
+        total_line = f"{total:.8f}\t{total_linear:.8f}"
+
+    rows = [total_line]
+    table = np.concatenate(
+        [node_feature, node_ids, positions, node_output_x, out_sq, out_cube], axis=1
+    )
+    for r in table:
+        rows.append("\t".join(f"{v:.2f}" for v in r))
+
+    filename = os.path.join(
+        path, f"output{configuration + configuration_start}.txt"
+    )
+    with open(filename, "w") as f:
+        f.write("\n".join(rows))

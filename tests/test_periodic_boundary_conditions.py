@@ -1,0 +1,90 @@
+"""Periodic-boundary radius graphs: exact neighbor counts
+(reference /root/reference/tests/test_periodic_boundary_conditions.py:25-123;
+correctness baselines in BASELINE.md: H2 → 1 neighbor/atom (2 with self-loops),
+250-atom BCC Cr at r=5.0 → 14 neighbors/atom). No ase here: the BCC supercell is
+built by hand."""
+
+import json
+
+import numpy as np
+
+from hydragnn_tpu.graphs.sample import GraphSample
+from hydragnn_tpu.preprocess.graph_build import periodic_radius_graph, radius_graph
+
+
+def unittest_periodic(config, sample, expected_neighbors, expected_with_loops):
+    radius = config["Architecture"]["radius"]
+    max_neigh = config["Architecture"]["max_neighbours"]
+    num_nodes = sample.num_nodes
+    pos_before = np.array(sample.pos)
+    x_before = np.array(sample.x)
+
+    ei_no_loops, lengths = periodic_radius_graph(
+        sample.pos, sample.supercell_size, radius, max_neigh, loop=False
+    )
+    ei_loops, _ = periodic_radius_graph(
+        sample.pos, sample.supercell_size, radius, max_neigh, loop=True
+    )
+
+    assert ei_no_loops.shape[1] == expected_neighbors * num_nodes
+    assert ei_loops.shape[1] == expected_with_loops * num_nodes
+
+    # Nodes unmodified.
+    assert np.array_equal(pos_before, sample.pos)
+    assert np.array_equal(x_before, sample.x)
+
+    # Edge lengths sane (reference checks < 5.0).
+    assert np.all(lengths <= radius + 1e-9)
+    assert np.all(lengths > 0)
+
+
+def pytest_periodic_h2():
+    with open("./tests/inputs/ci_periodic.json") as f:
+        config = json.load(f)
+    sample = GraphSample(
+        x=np.array([[3.0, 5.0, 7.0], [9.0, 11.0, 13.0]]),
+        pos=np.array([[1.0, 1.0, 1.0], [1.43, 1.43, 1.43]]),
+        y=np.array([99.0]),
+        supercell_size=np.eye(3) * 3.0,
+    )
+    # Only 1 bond per atom; with self loops each atom also sees itself.
+    unittest_periodic(config, sample, 1, 2)
+
+
+def pytest_periodic_bcc_large():
+    with open("./tests/inputs/ci_periodic.json") as f:
+        config = json.load(f)
+    config["Architecture"]["radius"] = 5.0
+    # BCC Cr, a=3.6, orthorhombic cell (2 atoms), 5x5x5 supercell = 250 atoms.
+    a = 3.6
+    base = np.array([[0.0, 0.0, 0.0], [a / 2, a / 2, a / 2]])
+    positions = []
+    for i in range(5):
+        for j in range(5):
+            for k in range(5):
+                positions.append(base + np.array([i, j, k]) * a)
+    positions = np.concatenate(positions)
+    sample = GraphSample(
+        x=np.random.default_rng(0).normal(size=(250, 1)),
+        pos=positions,
+        y=np.array([99.0]),
+        supercell_size=np.eye(3) * (5 * a),
+    )
+    # r=5.0 covers first (8) + second (6) BCC neighbor shells.
+    unittest_periodic(config, sample, 14, 15)
+
+
+def pytest_flat_radius_graph_matches_pbc_interior():
+    """Flat radius graph on an isolated H2: same single bond, no images."""
+    pos = np.array([[1.0, 1.0, 1.0], [1.43, 1.43, 1.43]])
+    ei, _ = radius_graph(pos, radius=0.9, max_neighbours=10)
+    assert ei.shape[1] == 2  # one directed edge each way
+    assert set(map(tuple, ei.T)) == {(0, 1), (1, 0)}
+
+
+def pytest_max_neighbours_cap():
+    rng = np.random.default_rng(1)
+    pos = rng.random((30, 3)) * 0.5  # dense cloud, everyone in range
+    ei, _ = radius_graph(pos, radius=1.0, max_neighbours=5)
+    counts = np.bincount(ei[1], minlength=30)
+    assert counts.max() <= 5

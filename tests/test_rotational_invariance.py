@@ -1,0 +1,75 @@
+"""Rotational invariance of the radius-graph + edge-length pipeline under
+NormalizeRotation (reference /root/reference/tests/test_rotational_invariance.py:
+52-116): edge sets and lengths must match between a structure and any rigid
+rotation of it, tol 1e-4 fp32 / 1e-14 fp64 (host-side numpy is float64)."""
+
+import numpy as np
+
+from hydragnn_tpu.graphs.sample import GraphSample
+from hydragnn_tpu.preprocess.graph_build import (
+    add_edge_lengths,
+    compute_edges,
+    normalize_rotation,
+)
+
+
+def _rotation_matrix(rng):
+    # QR of a random gaussian → uniform-ish random rotation.
+    q, r = np.linalg.qr(rng.normal(size=(3, 3)))
+    q *= np.sign(np.diag(r))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    return q
+
+
+def _edge_set_with_lengths(sample):
+    return {
+        (int(s), int(r)): float(l)
+        for s, r, l in zip(
+            sample.edge_index[0], sample.edge_index[1], sample.edge_attr[:, -1]
+        )
+    }
+
+
+def unittest_rotational_invariance(pos, tol):
+    radius, max_neigh = 1.5, 20
+
+    def build(p):
+        s = GraphSample(x=np.ones((len(p), 1)), pos=np.array(p, dtype=np.float64))
+        normalize_rotation(s)
+        compute_edges(s, radius, max_neigh)
+        add_edge_lengths(s)
+        return s
+
+    base = build(pos)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        rot = _rotation_matrix(rng)
+        rotated = build(pos @ rot.T)
+        e_base = _edge_set_with_lengths(base)
+        e_rot = _edge_set_with_lengths(rotated)
+        assert set(e_base) == set(e_rot), "edge sets differ under rotation"
+        for k in e_base:
+            assert abs(e_base[k] - e_rot[k]) < tol, (k, e_base[k], e_rot[k])
+
+
+def pytest_rotational_invariance_bct():
+    """Body-centered-tetragonal lattice (reference :52-76)."""
+    a, c = 1.0, 1.4
+    cells = []
+    for i in range(2):
+        for j in range(2):
+            for k in range(2):
+                off = np.array([i * a, j * a, k * c])
+                cells.append(off)
+                cells.append(off + np.array([a / 2, a / 2, c / 2]))
+    pos = np.asarray(cells, dtype=np.float64)
+    unittest_rotational_invariance(pos, tol=1e-14)
+
+
+def pytest_rotational_invariance_random_graphs():
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        n = int(rng.integers(5, 20))
+        pos = rng.random((n, 3)) * 2.0
+        unittest_rotational_invariance(pos, tol=1e-14)
