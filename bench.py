@@ -1,0 +1,81 @@
+"""Benchmark: graphs/sec/chip on the north-star workload (BASELINE.json) — PNA
+multi-task (graph + node heads) training on a QM9-scale synthetic molecular
+dataset. Runs on whatever jax.devices() provides (the real TPU chip under the
+driver; CPU elsewhere).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+The reference publishes no benchmark numbers (BASELINE.md); vs_baseline is
+measured against a fixed pinned figure from this framework's first TPU run so
+later rounds track relative progress.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Pinned reference throughput (graphs/sec/chip) measured on the round-1 TPU
+# (v5e) run of this framework. Later rounds compare against this fixed number.
+BASELINE_GRAPHS_PER_SEC = 388825.5
+
+BATCH_SIZE = 256
+HIDDEN = 64
+LAYERS = 3
+STEPS = 60
+WARMUP = 5
+
+
+def main():
+    import jax
+
+    from __graft_entry__ import DIMS, TYPES, _build_model, _make_graphs
+    from hydragnn_tpu.graphs import collate_graphs
+    from hydragnn_tpu.models import init_model_variables
+    from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    rng = np.random.default_rng(0)
+    # QM9-like sizes: ~18 heavy+H atoms per molecule.
+    graphs = _make_graphs(BATCH_SIZE, rng, n_lo=12, n_hi=26)
+    batch = collate_graphs(graphs, TYPES, DIMS, edge_dim=1)
+
+    model = _build_model(hidden=HIDDEN, layers=LAYERS)
+    variables = init_model_variables(model, batch)
+    opt = select_optimizer("AdamW", 1e-3)
+    state = create_train_state(model, variables, opt)
+    step = make_train_step(model, opt)
+    key = jax.random.PRNGKey(0)
+
+    # Warmup (compile) then timed steps.
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+
+    graphs_per_sec = BATCH_SIZE * STEPS / elapsed
+    vs = (
+        graphs_per_sec / BASELINE_GRAPHS_PER_SEC
+        if BASELINE_GRAPHS_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_throughput_pna_multitask",
+                "value": round(graphs_per_sec, 2),
+                "unit": "graphs/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

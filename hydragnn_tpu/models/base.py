@@ -84,6 +84,8 @@ class HydraGNN(nn.Module):
     num_nodes: Optional[int] = None
     initial_bias: Optional[float] = None
     ilossweights_nll: int = 0
+    # Mesh axis name for edge-sharded graph parallelism (None = off).
+    graph_axis: Optional[str] = None
     # Conv-family-specific static parameters.
     edge_dim: Optional[int] = None
     pna_deg_avg_log: float = 1.0
@@ -104,12 +106,13 @@ class HydraGNN(nn.Module):
 
     def _make_conv(self, in_dim: int, out_dim: int, name: str, concat: bool = True):
         ct = self.conv_type
+        ax = self.graph_axis
         if ct == "SAGE":
-            return SAGEConv(out_dim, name=name)
+            return SAGEConv(out_dim, axis_name=ax, name=name)
         if ct == "GIN":
-            return GINConv(out_dim, name=name)
+            return GINConv(out_dim, axis_name=ax, name=name)
         if ct == "MFC":
-            return MFCConv(out_dim, self.mfc_max_degree, name=name)
+            return MFCConv(out_dim, self.mfc_max_degree, axis_name=ax, name=name)
         if ct == "GAT":
             return GATv2Conv(
                 out_dim,
@@ -117,16 +120,18 @@ class HydraGNN(nn.Module):
                 negative_slope=self.gat_negative_slope,
                 concat=concat,
                 dropout=self.dropout,
+                axis_name=ax,
                 name=name,
             )
         if ct == "CGCNN":
-            return CGConv(edge_dim=self.edge_dim or 0, name=name)
+            return CGConv(edge_dim=self.edge_dim or 0, axis_name=ax, name=name)
         if ct == "PNA":
             return PNAConv(
                 out_dim,
                 deg_avg_log=self.pna_deg_avg_log,
                 deg_avg_lin=self.pna_deg_avg_lin,
                 edge_dim=self.edge_dim,
+                axis_name=ax,
                 name=name,
             )
         raise ValueError(f"Unknown conv_type {ct}")

@@ -28,11 +28,12 @@ class SAGEConv(nn.Module):
     Reference: /root/reference/hydragnn/models/SAGEStack.py:24-31."""
 
     out_dim: int
+    axis_name: Optional[str] = None  # mesh axis for edge-sharded graph parallelism
 
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
         n = x.shape[0]
-        nbr = seg.segment_mean(x[senders], receivers, n, mask=edge_mask)
+        nbr = seg.segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
         return nn.Dense(self.out_dim, name="lin_nbr")(nbr) + nn.Dense(
             self.out_dim, name="lin_self"
         )(x)
@@ -44,12 +45,13 @@ class GINConv(nn.Module):
 
     out_dim: int
     eps_init: float = 100.0
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
         n = x.shape[0]
         eps = self.param("eps", nn.initializers.constant(self.eps_init), ())
-        agg = seg.segment_sum(x[senders], receivers, n)
+        agg = seg.segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)
@@ -64,6 +66,7 @@ class MFCConv(nn.Module):
 
     out_dim: int
     max_degree: int
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
@@ -74,9 +77,9 @@ class MFCConv(nn.Module):
         )
         w_nbr = self.param("w_nbr", nn.initializers.lecun_normal(), (d, f, self.out_dim))
         b = self.param("bias", nn.initializers.zeros, (d, self.out_dim))
-        deg = seg.segment_count(receivers, n, mask=edge_mask).astype(jnp.int32)
+        deg = seg.segment_count(receivers, n, mask=edge_mask, axis_name=self.axis_name).astype(jnp.int32)
         deg = jnp.clip(deg, 0, self.max_degree)
-        agg = seg.segment_sum(x[senders], receivers, n)
+        agg = seg.segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
         out = jnp.einsum("nf,nfo->no", x, w_self[deg]) + jnp.einsum(
             "nf,nfo->no", agg, w_nbr[deg]
         )
@@ -94,6 +97,7 @@ class GATv2Conv(nn.Module):
     negative_slope: float = 0.05
     concat: bool = True
     dropout: float = 0.25
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
@@ -103,20 +107,26 @@ class GATv2Conv(nn.Module):
         x_dst = nn.Dense(h * f, name="lin_dst")(x).reshape(n, h, f)
 
         # Self-loops: append one identity edge per node (static shape E_pad + N_pad).
+        # Under graph parallelism only shard 0 contributes the self-loops, or the
+        # psum'd aggregation would count them axis_size times.
+        self_mask = node_mask
+        if self.axis_name is not None:
+            self_mask = self_mask & (jax.lax.axis_index(self.axis_name) == 0)
         s = jnp.concatenate([senders, jnp.arange(n, dtype=senders.dtype)])
         r = jnp.concatenate([receivers, jnp.arange(n, dtype=receivers.dtype)])
-        m = jnp.concatenate([edge_mask, node_mask])
+        m = jnp.concatenate([edge_mask, self_mask])
 
         att = self.param("att", nn.initializers.lecun_normal(), (h, f))
         pre = nn.leaky_relu(x_src[s] + x_dst[r], self.negative_slope)  # [E', h, f]
         logits = jnp.einsum("ehf,hf->eh", pre, att)
-        alpha = seg.segment_softmax(logits, r, n, mask=m)  # [E', h]
+        alpha = seg.segment_softmax(logits, r, n, mask=m, axis_name=self.axis_name)  # [E', h]
         if train and self.dropout > 0.0:
             rng = self.make_rng("dropout")
             keep = jax.random.bernoulli(rng, 1.0 - self.dropout, alpha.shape)
             alpha = jnp.where(keep, alpha / (1.0 - self.dropout), 0.0)
         msgs = x_src[s] * alpha[..., None]  # [E', h, f]
-        out = seg.segment_sum(msgs, r, n)  # [N, h, f]
+        msgs = jnp.where(m[:, None, None], msgs, 0.0)
+        out = seg.segment_sum(msgs, r, n, axis_name=self.axis_name)  # [N, h, f]
         if self.concat:
             out = out.reshape(n, h * f)
             bias = self.param("bias", nn.initializers.zeros, (h * f,))
@@ -132,6 +142,7 @@ class CGConv(nn.Module):
     (reference CGCNNStack.py:44-51 → PyG CGConv with aggr='add')."""
 
     edge_dim: int = 0
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
@@ -145,7 +156,7 @@ class CGConv(nn.Module):
         msgs = gate * core
         # Padding edges carry nonzero softplus output — mask before aggregation.
         msgs = jnp.where(edge_mask[:, None], msgs, 0.0)
-        return x + seg.segment_sum(msgs, receivers, n)
+        return x + seg.segment_sum(msgs, receivers, n, axis_name=self.axis_name)
 
 
 class PNAConv(nn.Module):
@@ -162,6 +173,7 @@ class PNAConv(nn.Module):
     deg_avg_log: float
     deg_avg_lin: float
     edge_dim: Optional[int] = None
+    axis_name: Optional[str] = None
     aggregators: Tuple[str, ...] = ("mean", "min", "max", "std")
     scalers: Tuple[str, ...] = ("identity", "amplification", "attenuation", "linear")
 
@@ -177,18 +189,18 @@ class PNAConv(nn.Module):
         aggs = []
         for a in self.aggregators:
             if a == "mean":
-                aggs.append(seg.segment_mean(msg, receivers, n, mask=edge_mask))
+                aggs.append(seg.segment_mean(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
             elif a == "min":
-                aggs.append(seg.segment_min(msg, receivers, n, mask=edge_mask))
+                aggs.append(seg.segment_min(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
             elif a == "max":
-                aggs.append(seg.segment_max(msg, receivers, n, mask=edge_mask))
+                aggs.append(seg.segment_max(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
             elif a == "std":
-                aggs.append(seg.segment_std(msg, receivers, n, mask=edge_mask))
+                aggs.append(seg.segment_std(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
             else:
                 raise ValueError(f"Unknown aggregator {a}")
         agg = jnp.stack(aggs, axis=1)  # [N, A, f]
 
-        deg = jnp.maximum(seg.segment_count(receivers, n, mask=edge_mask), 1.0)
+        deg = jnp.maximum(seg.segment_count(receivers, n, mask=edge_mask, axis_name=self.axis_name), 1.0)
         log_deg = jnp.log(deg + 1.0)
         scales = []
         for s in self.scalers:

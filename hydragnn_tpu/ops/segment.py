@@ -3,9 +3,14 @@ PyTorch-Geometric message passing leans on (reference conv calls:
 /root/reference/hydragnn/models/Base.py:236-243, global_mean_pool at Base.py:250).
 
 All ops take a static ``num_segments`` so shapes are compile-time constants, and an
-optional boolean mask marking valid rows. Under the GraphBatch padding contract
-(padding edges connect padding nodes) masks are usually only needed for statistics
-(mean/std/min/max/softmax) where identity elements differ from zero.
+optional boolean mask marking valid rows.
+
+Graph parallelism (the long-context analog axis, SURVEY.md §5.7): every op accepts
+an optional ``axis_name``. When set, the edge/data rows are assumed sharded across
+that mesh axis (nodes replicated); each device reduces its local shard and the
+partial segment results are combined with the matching XLA collective
+(psum / pmax / pmin) over ICI. This turns large-graph message passing into
+edge-partitioned SPMD with one collective per aggregation.
 """
 
 from __future__ import annotations
@@ -18,6 +23,15 @@ import jax.numpy as jnp
 _BIG = 1e30
 
 
+def _pmax(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Differentiable cross-device max (lax.pmax has no VJP rule)."""
+    return jnp.max(jax.lax.all_gather(x, axis_name), axis=0)
+
+
+def _pmin(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    return jnp.min(jax.lax.all_gather(x, axis_name), axis=0)
+
+
 def _expand(mask: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
     """Broadcast a [N] mask against [N, ...] data."""
     return mask.reshape(mask.shape + (1,) * (like.ndim - mask.ndim))
@@ -28,21 +42,26 @@ def segment_sum(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     if mask is not None:
         data = jnp.where(_expand(mask, data), data, 0)
-    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    out = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
 
 
 def segment_count(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     ones = jnp.ones(segment_ids.shape[0], dtype=jnp.float32)
     if mask is not None:
         ones = jnp.where(mask, ones, 0.0)
-    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+    return segment_sum(ones, segment_ids, num_segments, axis_name=axis_name)
 
 
 def segment_mean(
@@ -50,9 +69,10 @@ def segment_mean(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
-    total = segment_sum(data, segment_ids, num_segments, mask)
-    count = segment_count(segment_ids, num_segments, mask)
+    total = segment_sum(data, segment_ids, num_segments, mask, axis_name)
+    count = segment_count(segment_ids, num_segments, mask, axis_name)
     return total / jnp.maximum(count, 1.0).reshape(
         count.shape + (1,) * (total.ndim - count.ndim)
     )
@@ -64,10 +84,13 @@ def segment_max(
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
     fill: float = 0.0,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     if mask is not None:
         data = jnp.where(_expand(mask, data), data, -_BIG)
     out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    if axis_name is not None:
+        out = _pmax(out, axis_name)
     # Empty segments come back as -inf/-BIG: replace with `fill` so downstream
     # matmuls stay finite (isolated nodes have no incoming messages).
     return jnp.where(out <= -_BIG / 2, fill, out)
@@ -79,10 +102,13 @@ def segment_min(
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
     fill: float = 0.0,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     if mask is not None:
         data = jnp.where(_expand(mask, data), data, _BIG)
     out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+    if axis_name is not None:
+        out = _pmin(out, axis_name)
     return jnp.where(out >= _BIG / 2, fill, out)
 
 
@@ -92,11 +118,14 @@ def segment_std(
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
     eps: float = 1e-5,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     """Per-segment standard deviation, sqrt(relu(E[x^2]-E[x]^2) + eps) like PyG's
     PNA 'std' aggregator (uses a small eps for a finite gradient at zero)."""
-    mean = segment_mean(data, segment_ids, num_segments, mask)
-    mean_sq = segment_mean(jnp.square(data), segment_ids, num_segments, mask)
+    mean = segment_mean(data, segment_ids, num_segments, mask, axis_name)
+    mean_sq = segment_mean(
+        jnp.square(data), segment_ids, num_segments, mask, axis_name
+    )
     var = jax.nn.relu(mean_sq - jnp.square(mean))
     return jnp.sqrt(var + eps)
 
@@ -106,18 +135,25 @@ def segment_softmax(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     """Numerically-stable softmax normalized within each segment (GATv2 attention
-    over incoming edges). Masked-out rows get weight 0."""
+    over incoming edges). Masked-out rows get weight 0. Under graph parallelism
+    the per-segment max and denominator are reduced globally; the returned
+    weights are for the LOCAL edge shard."""
     if mask is not None:
         logits = jnp.where(_expand(mask, logits), logits, -_BIG)
     seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    if axis_name is not None:
+        seg_max = _pmax(seg_max, axis_name)
     seg_max = jnp.where(seg_max <= -_BIG / 2, 0.0, seg_max)
     shifted = logits - seg_max[segment_ids]
     exp = jnp.exp(shifted)
     if mask is not None:
         exp = jnp.where(_expand(mask, exp), exp, 0.0)
     denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    if axis_name is not None:
+        denom = jax.lax.psum(denom, axis_name)
     return exp / jnp.maximum(denom[segment_ids], 1e-16)
 
 

@@ -78,8 +78,22 @@ def make_mesh(
     """Device mesh for the train step: 'data' (batch/DP) × 'graph'
     (intra-graph node/edge sharding — the long-context analog axis)."""
     n = len(jax.devices())
+    if graph_axis < 1 or graph_axis > n:
+        raise ValueError(
+            f"graph_axis={graph_axis} must be in [1, {n}] (device count)"
+        )
     if data_axis is None:
+        if n % graph_axis != 0:
+            raise ValueError(
+                f"device count {n} is not divisible by graph_axis={graph_axis}; "
+                "pass data_axis explicitly to use a subset of devices"
+            )
         data_axis = n // graph_axis
+    if data_axis * graph_axis > n:
+        raise ValueError(
+            f"mesh {data_axis}x{graph_axis} needs {data_axis * graph_axis} "
+            f"devices but only {n} are available"
+        )
     devices = np.asarray(jax.devices()[: data_axis * graph_axis]).reshape(
         data_axis, graph_axis
     )

@@ -127,20 +127,18 @@ def update_predicted_values(
     sample.y = np.concatenate(output_feature, axis=0).astype(np.float32).reshape(-1)
 
 
-def composition_category(sample: GraphSample, base: int = 100) -> int:
-    """Category id = Σ sorted-frequency·base^rank over element frequencies
-    (serialized_dataset_loader.py:190-200)."""
-    freqs = np.bincount(np.asarray(sample.x[:, 0], dtype=np.int64))
-    freqs = sorted(int(f) for f in freqs if f > 0)
-    return sum(f * (base ** i) for i, f in enumerate(freqs))
-
-
 def stratified_subsample(
     dataset: List[GraphSample], subsample_percentage: float
 ) -> List[GraphSample]:
     """Stratified (by composition category) subsample of the dataset
-    (serialized_dataset_loader.py:172-217)."""
-    categories = [composition_category(s) for s in dataset]
+    (serialized_dataset_loader.py:172-217). Divergence from the reference, on
+    purpose: categories come from splitting.create_dataset_categories, which
+    handles min-max-normalized float element ids via np.unique — the reference's
+    bincount(int(x)) collapses all normalized elements except the max into one
+    bin, making its 'stratified' subsample effectively random."""
+    from .splitting import create_dataset_categories
+
+    categories = create_dataset_categories(dataset)
     sss = StratifiedShuffleSplit(
         n_splits=1, train_size=subsample_percentage, random_state=0
     )

@@ -48,6 +48,8 @@ def _(config: dict, mesh=None):
     )
     example = next(iter(test_loader))
     variables = init_model_variables(model, example)
+    if mesh is not None and mesh.shape.get("graph", 1) > 1:
+        model = model.clone(graph_axis="graph")
 
     log_name = get_log_name_config(config)
     variables, _ = load_existing_model(variables, log_name)

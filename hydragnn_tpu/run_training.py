@@ -57,6 +57,11 @@ def _(config: dict, mesh=None):
     )
     example = next(iter(train_loader))
     variables = init_model_variables(model, example)
+    # A mesh with a nontrivial 'graph' axis enables edge-sharded graph
+    # parallelism (bound after init — collective axes are unbound outside the
+    # sharded step).
+    if mesh is not None and mesh.shape.get("graph", 1) > 1:
+        model = model.clone(graph_axis="graph")
 
     optimizer = select_optimizer(
         config["NeuralNetwork"]["Training"]["optimizer"],

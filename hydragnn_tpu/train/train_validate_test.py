@@ -68,8 +68,16 @@ class TrainingDriver:
         self.mesh = mesh
         self.verbosity = verbosity
         self.n_devices = 1
+        self.multihost = jax.process_count() > 1
         if mesh is not None:
-            self.n_devices = mesh.shape["data"]
+            # Each process stacks only its LOCAL slice of the data axis; the
+            # stacked host-local array is lifted to a global jax.Array below —
+            # otherwise every host would feed its own copy and devices would
+            # silently take non-matching slices.
+            self.n_devices = (
+                mesh.local_mesh.shape["data"] if self.multihost
+                else mesh.shape["data"]
+            )
             self.train_step = make_train_step_dp(model, optimizer, mesh)
             self.eval_step = make_eval_step_dp(model, mesh)
         else:
@@ -79,20 +87,33 @@ class TrainingDriver:
 
     # ------------------------------------------------------------------ train
     def _device_groups(self, loader):
-        """Lazily yield per-device batch groups stacked for shard_map."""
+        """Lazily yield per-device batch groups stacked for shard_map. Used for
+        ANY mesh run (even data_axis=1 — the sharded step always expects the
+        leading device axis)."""
         group = []
         for b in loader:
             group.append(b)
             if len(group) == self.n_devices:
-                yield stack_batches(group, self.n_devices)
+                yield self._lift(stack_batches(group, self.n_devices))
                 group = []
         if group:
-            yield stack_batches(group, self.n_devices)
+            yield self._lift(stack_batches(group, self.n_devices))
+
+    def _lift(self, stacked):
+        """Host-local stacked batch → global jax.Array across processes."""
+        if not self.multihost:
+            return stacked
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        return multihost_utils.host_local_array_to_global_array(
+            stacked, self.mesh, P("data")
+        )
 
     def train_epoch(self, loader, profiler: Optional[Profiler] = None):
         metrics = EpochMetrics()
         batches = (
-            self._device_groups(loader) if self.n_devices > 1 else iter(loader)
+            self._device_groups(loader) if self.mesh is not None else iter(loader)
         )
         for batch in iterate_tqdm(batches, self.verbosity):
             self.state, m = self.train_step(self.state, batch, self.rng)
@@ -111,22 +132,31 @@ class TrainingDriver:
         true_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
         pred_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
 
+        def to_host(arr):
+            """Local rows of a possibly multi-host global array (per-process
+            values, like the reference's per-rank test() lists)."""
+            if self.multihost and hasattr(arr, "addressable_shards"):
+                return np.concatenate(
+                    [np.asarray(s.data) for s in arr.addressable_shards]
+                )
+            return np.asarray(arr)
+
         def consume(batch_host: GraphBatch, outputs):
             for ih, (htype, out) in enumerate(
                 zip(self.model.output_type, outputs)
             ):
-                out = np.asarray(out)
+                out = to_host(out)
                 if out.ndim == 3:  # DP: [D, rows, dim] → per-device slices
                     out = out.reshape(-1, out.shape[-1])
-                mask = np.asarray(
+                mask = to_host(
                     batch_host.graph_mask if htype == "graph" else batch_host.node_mask
                 ).reshape(-1)
-                tgt = np.asarray(batch_host.targets[ih]).reshape(-1, out.shape[-1])
+                tgt = to_host(batch_host.targets[ih]).reshape(-1, out.shape[-1])
                 pred_values[ih].append(out[mask])
                 true_values[ih].append(tgt[mask])
 
         batches = (
-            self._device_groups(loader) if self.n_devices > 1 else iter(loader)
+            self._device_groups(loader) if self.mesh is not None else iter(loader)
         )
         for batch in batches:
             m, outputs = self.eval_step(self.state, batch)

@@ -107,17 +107,38 @@ def make_eval_step(model: HydraGNN) -> Callable:
     return step
 
 
-# --------------------------------------------------------------------------- DP
-def _batch_pspec(batch: GraphBatch) -> GraphBatch:
-    """PartitionSpec tree: every array sharded on its leading (device) axis."""
-    return jax.tree_util.tree_map(lambda _: P("data"), batch)
+# ------------------------------------------------------------- DP × graph-par
+def _batch_pspec(batch: GraphBatch, graph_sharded: bool) -> GraphBatch:
+    """PartitionSpec tree. Every array is sharded on its leading (device) axis
+    over 'data'. With graph_sharded, edge arrays are ALSO sharded over 'graph'
+    (edge-partitioned message passing — nodes replicated, one collective per
+    aggregation inside the convs)."""
+    edge_spec = P("data", "graph") if graph_sharded else P("data")
+    return GraphBatch(
+        node_features=P("data"),
+        edge_features=None if batch.edge_features is None else edge_spec,
+        senders=edge_spec,
+        receivers=edge_spec,
+        node_graph=P("data"),
+        node_mask=P("data"),
+        edge_mask=edge_spec,
+        graph_mask=P("data"),
+        targets=tuple(P("data") for _ in batch.targets),
+        num_graphs_pad=batch.num_graphs_pad,
+    )
 
 
 def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
-    """Data-parallel step. ``batch`` arrays carry a leading device axis [D, ...];
-    each device runs local message passing on its shard, then grads and metrics
-    are psum'd over 'data' (the DDP-allreduce analog, over ICI)."""
+    """SPMD step over a ('data', 'graph') mesh. ``batch`` arrays carry a leading
+    device axis [D, ...] dealt over 'data'; when the model was built with
+    graph_axis='graph' and the mesh has a nontrivial 'graph' axis, edges are
+    additionally sharded over 'graph'. Grads are pmean'd over BOTH axes — with
+    JAX's psum-transposes-to-psum rule this recovers the exact full gradient
+    (replicated node contributions stay unscaled, edge-shard contributions sum)."""
     from jax.experimental.shard_map import shard_map
+
+    graph_sharded = model.graph_axis is not None and mesh.shape.get("graph", 1) > 1
+    grad_axes = ("data", "graph") if graph_sharded else ("data",)
 
     def _local(state, batch, rng):
         # Inside shard_map the leading device axis is size 1: drop it.
@@ -131,10 +152,24 @@ def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
         )
         (loss, (new_bstats, rmses)), grads = grad_fn(state.params)
         count = batch.count_real_graphs().astype(jnp.float32)
-        # Gradient allreduce (mean over devices), like DDP.
-        grads = jax.lax.pmean(grads, "data")
-        # Batch-stats allreduce keeps running statistics replicated.
-        new_bstats = jax.lax.pmean(new_bstats, "data")
+        # Gradient allreduce (the DDP-allreduce analog, over ICI), weighted by
+        # real-graph count so all-masked tail-padding batches contribute zero
+        # weight instead of diluting the step (count=0 ⇒ zero numerator term).
+        count_total = jax.lax.psum(count, "data")
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g * count, "data")
+            / jnp.maximum(count_total, 1.0),
+            grads,
+        )
+        new_bstats = jax.tree_util.tree_map(
+            lambda s: jax.lax.psum(s * count, "data")
+            / jnp.maximum(count_total, 1.0),
+            new_bstats,
+        )
+        if "graph" in grad_axes:
+            # Edge-shard contributions sum under pmean (psum-transpose rule).
+            grads = jax.lax.pmean(grads, "graph")
+            new_bstats = jax.lax.pmean(new_bstats, "graph")
         loss_sum = jax.lax.psum(loss * count, "data")
         rmses_sum = jax.lax.psum(rmses * count, "data")
         count_sum = jax.lax.psum(count, "data")
@@ -152,7 +187,7 @@ def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
         sharded = shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(), _batch_pspec(batch), P()),
+            in_specs=(P(), _batch_pspec(batch, graph_sharded), P()),
             out_specs=(P(), P()),
             check_rep=False,
         )
@@ -163,6 +198,8 @@ def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
 
 def make_eval_step_dp(model: HydraGNN, mesh) -> Callable:
     from jax.experimental.shard_map import shard_map
+
+    graph_sharded = model.graph_axis is not None and mesh.shape.get("graph", 1) > 1
 
     def _local(state, batch):
         batch = jax.tree_util.tree_map(lambda x: x[0], batch)
@@ -187,7 +224,7 @@ def make_eval_step_dp(model: HydraGNN, mesh) -> Callable:
         sharded = shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(), _batch_pspec(batch)),
+            in_specs=(P(), _batch_pspec(batch, graph_sharded)),
             out_specs=(P(), [P("data") for _ in model.output_dim]),
             check_rep=False,
         )
