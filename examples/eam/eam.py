@@ -1,0 +1,87 @@
+"""EAM (CFG-format) training via the high-level one-liner API
+(reference examples/eam/eam.py:1-5). Four config variants mirror the reference:
+energy / bulk / multitask / bulk_multitask.
+
+The reference assumes user-supplied FCC Ni-Nb CFG files; to stay runnable
+offline this script fabricates a deterministic FCC Ni/Nb dataset (extended CFG
++ ``.bulk`` sidecars) on first run: per-atom EAM-like energies and forces and a
+composition-dependent bulk modulus, all smooth functions of local structure."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+import hydragnn_tpu as hydragnn
+from hydragnn_tpu.preprocess.cfg_io import CfgData, write_cfg
+
+NI, NB = 28, 41
+MASS = {NI: 58.6934, NB: 92.90637}
+A0 = 3.52  # FCC lattice constant (Angstrom)
+
+
+def _generate_ninb(dir: str, num_config: int = 60, cells=(2, 2, 2)) -> None:
+    rng = np.random.default_rng(2027)
+    ux, uy, uz = cells
+    # FCC basis: corner + three face centers.
+    basis = np.array(
+        [[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]]
+    )
+    frac = np.concatenate(
+        [
+            basis + np.array([x, y, z])
+            for x in range(ux)
+            for y in range(uy)
+            for z in range(uz)
+        ]
+    )
+    cell = np.diag([A0 * ux, A0 * uy, A0 * uz]).astype(np.float64)
+    pos = frac / np.array([ux, uy, uz]) @ cell
+    n = pos.shape[0]
+    os.makedirs(dir, exist_ok=True)
+    for c in range(num_config):
+        numbers = rng.choice([NI, NB], size=n)
+        jitter = rng.normal(scale=0.03, size=(n, 3))
+        p = pos + jitter
+        frac_ni = float(np.mean(numbers == NI))
+        # EAM-flavored smooth per-atom energy: species term + displacement.
+        e_atom = (
+            np.where(numbers == NI, -4.45, -7.57)
+            + 0.5 * (jitter**2).sum(axis=1)
+            + 0.2 * frac_ni
+        )
+        forces = -1.0 * jitter  # harmonic restoring force
+        data = CfgData(
+            positions=p,
+            cell=cell,
+            numbers=numbers,
+            masses=np.array([MASS[z] for z in numbers]),
+            aux={
+                "c_peratom": e_atom,
+                "fx": forces[:, 0],
+                "fy": forces[:, 1],
+                "fz": forces[:, 2],
+            },
+        )
+        stem = os.path.join(dir, f"config{c}")
+        write_cfg(stem + ".cfg", data)
+        bulk_modulus = 180.0 + 20.0 * frac_ni - 40.0 * frac_ni * (1 - frac_ni)
+        with open(stem + ".bulk", "w") as f:
+            f.write(f"{e_atom.sum():.8f} 0.0 {bulk_modulus:.8f}\n")
+
+
+config_name = sys.argv[1] if len(sys.argv) > 1 else "NiNb_EAM_bulk_multitask"
+filepath = os.path.join(os.path.dirname(__file__), config_name + ".json")
+with open(filepath, "r") as f:
+    config = json.load(f)
+
+data_dir = os.path.join(os.path.dirname(__file__), "dataset", "FCC_Ni_Nb")
+if not os.path.isdir(data_dir):
+    _generate_ninb(data_dir)
+config["Dataset"]["path"] = {"total": data_dir}
+
+hydragnn.run_training(config)

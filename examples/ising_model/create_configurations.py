@@ -1,0 +1,100 @@
+"""Synthetic 3D Ising dataset generator (reference
+examples/ising_model/create_configurations.py:29-137, re-implemented vectorized).
+
+E = -(1/6) Σ_i S_i · (Σ_{j∈nn(i)} S_j + S_i) on an L×L×L periodic lattice, with
+an optional nonlinear spin function and random spin-magnitude scaling. For each
+down-spin count k: if C(L³, k) exceeds the cutoff, sample `cutoff` random
+configurations; otherwise enumerate every distinct configuration (down-site
+combinations — equivalent to the reference's multiset permutations without the
+sympy dependency).
+
+Files are written in the LSMS text layout the raw loader actually parses
+(positions in columns 2-4; the reference generator puts positions in columns
+1-3, which its own loader then misreads as (y, z, spin) — a quirk we do not
+copy): header = total energy; rows = [config_value, index, x, y, z, spin].
+"""
+
+import itertools
+import math
+import os
+import shutil
+import sys
+
+import numpy as np
+from scipy import special
+
+
+def e_dimensionless(config, L, spin_function, scale_spin, rng):
+    """Energy + per-site features for one configuration, vectorized."""
+    config = np.asarray(config, dtype=np.float64).reshape(L, L, L)
+    if scale_spin:
+        config = config * rng.random((L, L, L))
+    spin = spin_function(config)
+
+    # 6 periodic nearest neighbours + the site itself (reference :53-62).
+    nb = sum(np.roll(spin, s, axis=a) for a in range(3) for s in (+1, -1)) + spin
+    total_energy = float(-(nb * spin).sum() / 6.0)
+
+    grid = np.indices((L, L, L)).reshape(3, -1).T.astype(np.float64)
+    # x varies fastest in the reference's loop order; ours is z-fastest —
+    # irrelevant to training, every site appears exactly once.
+    return total_energy, config.reshape(-1), spin.reshape(-1), grid
+
+
+def write_to_file(total_energy, values, spins, positions, count_config, dir):
+    rows = [f"{total_energy:.8f}"]
+    for i in range(len(values)):
+        rows.append(
+            f"{values[i]:.6f}\t{i}\t{positions[i,0]:.2f}\t{positions[i,1]:.2f}"
+            f"\t{positions[i,2]:.2f}\t{spins[i]:.6f}"
+        )
+    with open(os.path.join(dir, f"output{count_config}.txt"), "w") as f:
+        f.write("\n".join(rows))
+
+
+def create_dataset(
+    L, histogram_cutoff, dir, spin_function=lambda x: x, scale_spin=False, seed=53
+):
+    rng = np.random.default_rng(seed)
+    n_sites = L**3
+    count_config = 0
+    for num_downs in range(n_sites):
+        primal = np.ones(n_sites)
+        primal[:num_downs] = -1.0
+        if special.binom(n_sites, num_downs) > histogram_cutoff:
+            configs = (rng.permutation(primal) for _ in range(histogram_cutoff))
+        else:
+            configs = (
+                np.where(np.isin(np.arange(n_sites), downs), -1.0, 1.0)
+                for downs in itertools.combinations(range(n_sites), num_downs)
+            )
+        for config in configs:
+            total_energy, values, spins, positions = e_dimensionless(
+                config, L, spin_function, scale_spin, rng
+            )
+            write_to_file(total_energy, values, spins, positions, count_config, dir)
+            count_config += 1
+    return count_config
+
+
+if __name__ == "__main__":
+    dir = os.path.join(os.path.dirname(__file__), "dataset", "ising_model")
+    if os.path.exists(dir):
+        shutil.rmtree(dir)
+    os.makedirs(dir)
+
+    number_atoms_per_dimension = 3
+    configurational_histogram_cutoff = 1000
+    if len(sys.argv) > 1:
+        configurational_histogram_cutoff = int(sys.argv[1])
+
+    # Sine spin function + randomized magnitudes: the nonlinear extension the
+    # reference trains on (create_configurations.py:121-137).
+    count = create_dataset(
+        number_atoms_per_dimension,
+        configurational_histogram_cutoff,
+        dir,
+        spin_function=lambda x: np.sin(np.pi * x / 2.0),
+        scale_spin=True,
+    )
+    print(f"wrote {count} configurations to {dir}")

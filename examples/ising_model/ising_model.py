@@ -1,0 +1,31 @@
+"""Train PNA on the synthetic 3D Ising dataset (high-level API). Generates a
+small dataset via create_configurations if none is present."""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+import hydragnn_tpu as hydragnn
+
+import numpy as np
+
+from create_configurations import create_dataset  # noqa: E402  (same dir)
+
+here = os.path.dirname(os.path.abspath(__file__))
+data_dir = os.path.join(here, "dataset", "ising_model")
+if not os.path.isdir(data_dir):
+    os.makedirs(data_dir)
+    create_dataset(
+        3, 50, data_dir, spin_function=lambda x: np.sin(np.pi * x / 2.0),
+        scale_spin=True,
+    )
+
+with open(os.path.join(here, "ising_model.json"), "r") as f:
+    config = json.load(f)
+config["Dataset"]["path"] = {"total": data_dir}
+
+hydragnn.run_training(config)

@@ -1,0 +1,65 @@
+"""LSMS-format training via the high-level one-liner API
+(reference examples/lsms/lsms.py:1-5: ``hydragnn.run_training(json)``).
+
+The reference assumes user-supplied FePt LSMS files. To keep the example
+runnable offline, a small deterministic FePt-like dataset (BCC supercells,
+random Fe/Pt occupancy; free energy, charge density and magnetic moments are
+smooth functions of the local composition) is generated on first run."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+import hydragnn_tpu as hydragnn
+
+FE, PT = 26.0, 78.0
+
+
+def _generate_fept(dir: str, num_config: int = 60, cells=(2, 2, 4)) -> None:
+    """BCC Fe/Pt supercells in LSMS text layout: header = free energy; node rows
+    = [protons, index, x, y, z, charge_density, magnetic_moment]."""
+    rng = np.random.default_rng(2026)
+    ux, uy, uz = cells
+    n = 2 * ux * uy * uz
+    base = np.array(
+        [(x, y, z) for x in range(ux) for y in range(uy) for z in range(uz)],
+        dtype=np.float64,
+    )
+    pos = np.concatenate([base, base + 0.5], axis=0)
+    os.makedirs(dir, exist_ok=True)
+    for c in range(num_config):
+        protons = rng.choice([FE, PT], size=n)
+        frac_fe = float(np.mean(protons == FE))
+        # Smooth per-atom properties of composition + position.
+        charge = protons + 0.3 * np.sin(pos.sum(axis=1)) + 0.1 * frac_fe
+        moment = np.where(protons == FE, 2.2, 0.3) * (
+            1.0 + 0.05 * np.cos(pos[:, 0])
+        )
+        free_energy = float(
+            -protons.sum() * 0.1 - 4.0 * frac_fe * (1.0 - frac_fe) * n
+        )
+        rows = [f"{free_energy:.8f}"]
+        for i in range(n):
+            rows.append(
+                f"{protons[i]:.2f}\t{i}\t{pos[i,0]:.4f}\t{pos[i,1]:.4f}"
+                f"\t{pos[i,2]:.4f}\t{charge[i]:.6f}\t{moment[i]:.6f}"
+            )
+        with open(os.path.join(dir, f"output{c}.txt"), "w") as f:
+            f.write("\n".join(rows))
+
+
+filepath = os.path.join(os.path.dirname(__file__), "lsms.json")
+with open(filepath, "r") as f:
+    config = json.load(f)
+
+data_dir = os.path.join(os.path.dirname(__file__), "dataset", "FePt_enthalpy")
+if not os.path.isdir(data_dir):
+    _generate_fept(data_dir)
+config["Dataset"]["path"] = {"total": data_dir}
+
+hydragnn.run_training(config)

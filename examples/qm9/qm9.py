@@ -1,0 +1,92 @@
+"""QM9 free-energy regression via the mid-level composable API
+(reference examples/qm9/qm9.py:1-109): load → pack targets → split → loaders →
+config completion → model → optimizer/scheduler → epoch loop."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+import hydragnn_tpu as hydragnn
+from hydragnn_tpu.datasets.qm9 import PROPERTY_INDEX
+
+num_samples = 1000
+
+filename = os.path.join(os.path.dirname(__file__), "qm9.json")
+with open(filename, "r") as f:
+    config = json.load(f)
+verbosity = config["Verbosity"]["level"]
+arch_config = config["NeuralNetwork"]["Architecture"]
+var_config = config["NeuralNetwork"]["Variables_of_interest"]
+
+compute_edges = hydragnn.preprocess.get_radius_graph_config(arch_config)
+
+
+# Update each sample prior to loading (examples/qm9/qm9.py:15-30): node
+# descriptor = element type, target = free energy per atom.
+def qm9_pre_transform(sample):
+    sample.y = np.array(
+        [sample.y[PROPERTY_INDEX["G"]] / sample.num_nodes], dtype=np.float32
+    )
+    hydragnn.preprocess.update_predicted_values(
+        var_config["type"], var_config["output_index"], [1], [1], sample
+    )
+    compute_edges(sample)
+    return sample
+
+
+os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
+
+world_size, world_rank = hydragnn.parallel.setup_ddp()
+
+log_name = "qm9_test"
+hydragnn.utils.setup_log(log_name)
+
+dataset = hydragnn.datasets.load_qm9(
+    root="dataset/qm9", num_samples=num_samples, pre_transform=qm9_pre_transform
+)
+train, val, test = hydragnn.preprocess.split_dataset(
+    dataset, config["NeuralNetwork"]["Training"]["perc_train"], False
+)
+train_loader, val_loader, test_loader, sampler_list = (
+    hydragnn.preprocess.create_dataloaders(
+        train, val, test, config["NeuralNetwork"]["Training"]["batch_size"]
+    )
+)
+
+config = hydragnn.utils.update_config(config, train_loader, val_loader, test_loader)
+
+model = hydragnn.models.create_model_config(
+    config=config["NeuralNetwork"]["Architecture"], verbosity=verbosity
+)
+variables = hydragnn.models.init_model_variables(model, next(iter(train_loader)))
+
+learning_rate = config["NeuralNetwork"]["Training"]["learning_rate"]
+optimizer = hydragnn.utils.select_optimizer("AdamW", learning_rate)
+scheduler = hydragnn.utils.ReduceLROnPlateau(
+    factor=0.5, patience=5, min_lr=0.00001
+)
+
+writer = hydragnn.utils.get_summary_writer(log_name)
+os.makedirs("./logs/" + log_name, exist_ok=True)
+with open("./logs/" + log_name + "/config.json", "w") as f:
+    json.dump(config, f)
+
+state = hydragnn.train.create_train_state(model, variables, optimizer)
+driver = hydragnn.train.TrainingDriver(
+    model, optimizer, state, verbosity=verbosity
+)
+hydragnn.train.train_validate_test(
+    driver,
+    train_loader,
+    val_loader,
+    test_loader,
+    config["NeuralNetwork"]["Training"]["num_epoch"],
+    writer=writer,
+    scheduler=scheduler,
+    verbosity=verbosity,
+)

@@ -4,7 +4,18 @@ trainer with the capabilities of HydraGNN (reference: /root/reference).
 Public API mirrors the reference (hydragnn/__init__.py:1-3): two entry functions
 driven by one JSON config, plus the composable mid-level pieces."""
 
-from . import graphs, models, ops, parallel, postprocess, preprocess, train, utils
+from . import (
+    datasets,
+    graphs,
+    models,
+    ops,
+    parallel,
+    postprocess,
+    preprocess,
+    tools,
+    train,
+    utils,
+)
 from .run_training import run_training
 from .run_prediction import run_prediction
 

@@ -1,0 +1,13 @@
+"""Self-contained dataset loaders (no torch_geometric dependency).
+
+The reference examples lean on PyG's built-in ``QM9``/``MD17`` download-and-cache
+datasets (/root/reference/examples/qm9/qm9.py:63-65, examples/md17/md17.py:66-71).
+Here the loaders read the standard on-disk formats directly and, when no data is
+present (e.g. air-gapped CI), fall back to a clearly-announced deterministic
+synthetic stand-in so every example stays runnable offline.
+"""
+
+from .md17 import load_md17
+from .qm9 import load_qm9
+
+__all__ = ["load_qm9", "load_md17"]

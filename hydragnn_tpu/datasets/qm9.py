@@ -1,0 +1,115 @@
+"""QM9 (GDB-9) loader → list of GraphSample.
+
+Reads the published GDB-9 extended-XYZ format if present under ``<root>/raw/``:
+
+    line 0:  natoms
+    line 1:  "gdb <id>  A B C mu alpha homo lumo gap r2 zpve U0 U H G Cv"
+    lines 2..natoms+1:  "<element>  x y z  mulliken_charge"
+    (then frequencies / SMILES / InChI lines, ignored)
+
+Per-sample targets are the 15 scalar properties in file order; ``PROPERTY_INDEX``
+maps the names used by the reference example (free energy G = index 13 here,
+index 10 in PyG's reordered target matrix — examples/qm9/qm9.py:18-19).
+
+With no on-disk data, ``load_qm9`` generates a deterministic synthetic
+molecular dataset: small random H/C/N/O/F clusters whose "free energy" is a
+smooth function of composition and geometry, so example scripts and smoke tests
+still exercise the full pipeline offline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..graphs.sample import GraphSample
+
+ELEMENTS = {"H": 1, "C": 6, "N": 7, "O": 8, "F": 9}
+
+# name → column in the per-file property vector (file order, after the 3
+# rotational constants A,B,C).
+PROPERTY_NAMES = [
+    "A", "B", "C", "mu", "alpha", "homo", "lumo", "gap", "r2", "zpve",
+    "U0", "U", "H", "G", "Cv",
+]
+PROPERTY_INDEX = {name: i for i, name in enumerate(PROPERTY_NAMES)}
+
+
+def _parse_xyz(path: str) -> Optional[GraphSample]:
+    with open(path, "r") as fh:
+        lines = fh.readlines()
+    natoms = int(lines[0])
+    props = np.array(
+        [float(t.replace("*^", "e")) for t in lines[1].split()[2:]],
+        dtype=np.float64,
+    )
+    pos = np.empty((natoms, 3), dtype=np.float32)
+    z = np.empty((natoms, 1), dtype=np.float32)
+    for i, line in enumerate(lines[2 : 2 + natoms]):
+        tok = line.replace("*^", "e").split()
+        z[i, 0] = ELEMENTS[tok[0]]
+        pos[i] = [float(t) for t in tok[1:4]]
+    return GraphSample(x=z, pos=pos, y=props.astype(np.float32))
+
+
+def _synthetic_qm9(num_samples: int, seed: int = 7) -> List[GraphSample]:
+    """Deterministic stand-in: clusters of 6-20 atoms; every scalar property is
+    a smooth, learnable function of composition and geometry."""
+    rng = np.random.default_rng(seed)
+    zs = np.array(list(ELEMENTS.values()), dtype=np.float32)
+    samples = []
+    for _ in range(num_samples):
+        n = int(rng.integers(6, 21))
+        z = rng.choice(zs, size=(n, 1)).astype(np.float32)
+        pos = (rng.random((n, 3)).astype(np.float32) - 0.5) * (2.0 * n ** (1 / 3))
+        r2 = float(np.sum(pos**2))
+        comp = float(z.sum())
+        props = np.zeros(len(PROPERTY_NAMES), dtype=np.float32)
+        # Fill every property with a distinct smooth combination so any
+        # output_index choice in a config is trainable.
+        for k in range(len(PROPERTY_NAMES)):
+            props[k] = (
+                0.1 * (k + 1) * comp / n
+                + 0.01 * r2 / n
+                + 0.05 * np.sin(0.1 * (k + 1) * comp)
+            )
+        samples.append(GraphSample(x=z, pos=pos, y=props))
+    return samples
+
+
+def load_qm9(
+    root: str = "dataset/qm9",
+    num_samples: Optional[int] = None,
+    pre_transform=None,
+    pre_filter=None,
+) -> List[GraphSample]:
+    """QM9 as GraphSamples; raw GDB-9 .xyz files under ``<root>/raw`` if
+    available, else the synthetic offline stand-in (1000 samples by default).
+
+    ``pre_transform(sample) -> sample`` and ``pre_filter(sample) -> bool`` mirror
+    the PyG hooks the reference example uses (examples/qm9/qm9.py:15-34).
+    """
+    raw_dir = os.path.join(root, "raw")
+    samples: List[GraphSample] = []
+    if os.path.isdir(raw_dir):
+        files = sorted(f for f in os.listdir(raw_dir) if f.endswith(".xyz"))
+        if num_samples is not None:
+            files = files[:num_samples]
+        for f in files:
+            s = _parse_xyz(os.path.join(raw_dir, f))
+            if s is not None:
+                samples.append(s)
+    if not samples:
+        print(
+            f"load_qm9: no raw GDB-9 files under {raw_dir}; "
+            "using the deterministic synthetic offline stand-in."
+        )
+        samples = _synthetic_qm9(num_samples or 1000)
+
+    if pre_filter is not None:
+        samples = [s for s in samples if pre_filter(s)]
+    if pre_transform is not None:
+        samples = [pre_transform(s) for s in samples]
+    return samples

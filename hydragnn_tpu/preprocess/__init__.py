@@ -4,6 +4,7 @@ from .graph_build import (
     add_edge_lengths,
     check_if_graph_size_variable,
     compute_edges,
+    get_radius_graph_config,
     normalize_rotation,
     periodic_radius_graph,
     radius_graph,

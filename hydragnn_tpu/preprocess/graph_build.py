@@ -138,6 +138,28 @@ def compute_edges(sample: GraphSample, radius, max_neighbours, periodic=False):
     return sample
 
 
+def get_radius_graph_config(arch_config: dict):
+    """Closure building edges from an Architecture config block, the analog of
+    the reference's transform factory (preprocess/utils.py:51-80) used by the
+    md17 example (examples/md17/md17.py:64)."""
+
+    def transform(sample: GraphSample) -> GraphSample:
+        compute_edges(
+            sample,
+            radius=arch_config["radius"],
+            max_neighbours=arch_config["max_neighbours"],
+            periodic=arch_config.get("periodic_boundary_conditions", False),
+        )
+        if "lengths" in arch_config.get("edge_features", []) or arch_config.get(
+            "periodic_boundary_conditions", False
+        ):
+            if sample.edge_attr is None:
+                add_edge_lengths(sample)
+        return sample
+
+    return transform
+
+
 def add_edge_lengths(sample: GraphSample) -> GraphSample:
     """torch_geometric.transforms.Distance(norm=False, cat=True): append |p_r - p_s|
     to edge_attr."""

@@ -32,9 +32,14 @@ class SerializedDataLoader:
         self.graph_feature_name = ds["graph_features"]["name"]
         self.graph_feature_dim = ds["graph_features"]["dim"]
         self.graph_feature_col = ds["graph_features"]["column_index"]
-        self.rotational_invariance = ds["rotational_invariance"]
+        # Defaulted when absent (divergence from the reference, which requires
+        # both keys — serialized_dataset_loader.py:49 — even though its own
+        # ising_model.json omits them).
+        self.rotational_invariance = ds.get("rotational_invariance", False)
         arch = config["NeuralNetwork"]["Architecture"]
-        self.periodic_boundary_conditions = arch["periodic_boundary_conditions"]
+        self.periodic_boundary_conditions = arch.get(
+            "periodic_boundary_conditions", False
+        )
         self.radius = arch["radius"]
         self.max_neighbours = arch["max_neighbours"]
         voi = config["NeuralNetwork"]["Variables_of_interest"]

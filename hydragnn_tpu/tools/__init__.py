@@ -1,0 +1,11 @@
+from .lsms import (
+    compositional_histogram_cutoff,
+    compute_formation_enthalpy,
+    convert_raw_data_energy_to_gibbs,
+)
+
+__all__ = [
+    "convert_raw_data_energy_to_gibbs",
+    "compute_formation_enthalpy",
+    "compositional_histogram_cutoff",
+]

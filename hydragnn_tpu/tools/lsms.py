@@ -1,0 +1,256 @@
+"""Offline LSMS dataset tooling: total energy → formation enthalpy → formation
+Gibbs free energy, and compositional-histogram downselection.
+
+Behavioral parity with the reference offline utilities
+(/root/reference/utils/lsms/convert_total_energy_to_formation_gibbs.py:30-183 and
+/root/reference/utils/lsms/compositional_histogram_cutoff.py:16-75), re-implemented
+vectorized:
+
+  * A directory of LSMS text files (one header line whose first token is the total
+    energy in Rydberg, then one row per atom with the proton count in column 0) is
+    rewritten into ``<dir>_gibbs_energy/`` with the total energy replaced by the
+    formation Gibbs free energy at a given temperature.
+  * Formation enthalpy = total energy − linear mixing energy, where the linear
+    mixing energy interpolates the per-atom energies of the two pure-element
+    configurations (binary alloys only).
+  * Entropy is the *configurational* (thermodynamic) term
+    k_B · ln C(num_atoms, count_element1) in Rydberg/K; we evaluate the
+    log-binomial via ``lgamma`` so large supercells don't overflow.
+  * ``compositional_histogram_cutoff`` caps the number of samples per composition
+    bin, symlinking the survivors into ``<dir>_histogram_cutoff/``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# LSMS energies are in Rydberg; Boltzmann constant converted accordingly
+# (reference convert_total_energy_to_formation_gibbs.py:174-177).
+_KB_JOULE_PER_KELVIN = 1.380649e-23
+_JOULE_TO_RYDBERG = 4.5874208973812e17
+KB_RYDBERG_PER_KELVIN = _KB_JOULE_PER_KELVIN * _JOULE_TO_RYDBERG
+
+
+def _log_binomial(n: int, k: int) -> float:
+    """ln C(n, k) computed stably for arbitrarily large supercells."""
+    if k < 0 or k > n:
+        return -math.inf
+    return (
+        math.lgamma(n + 1) - math.lgamma(k + 1) - math.lgamma(n - k + 1)
+    )
+
+
+def _read_lsms_file(path: str) -> Tuple[str, List[str], np.ndarray]:
+    """Returns (total_energy_token, raw_lines, atoms_table).
+
+    LSMS format: a single header line whose first whitespace token is the total
+    energy, followed by one row of numbers per atom (column 0 = atomic number).
+    """
+    with open(path, "r") as fh:
+        lines = fh.readlines()
+    energy_token = lines[0].split()[0]
+    atoms = np.loadtxt(lines[1:], ndmin=2)
+    return energy_token, lines, atoms
+
+
+def _element_counts(
+    atoms: np.ndarray, elements_list: Sequence[float]
+) -> np.ndarray:
+    """Per-element atom counts aligned with sorted(elements_list); raises if the
+    sample contains an element outside the binary."""
+    species = atoms[:, 0]
+    ordered = sorted(elements_list)
+    counts = np.array([np.count_nonzero(species == e) for e in ordered])
+    if counts.sum() != atoms.shape[0]:
+        unknown = sorted(set(np.unique(species)) - set(ordered))
+        raise ValueError(
+            f"sample contains element(s) {unknown} not in the binary {ordered}"
+        )
+    return counts
+
+
+def compute_formation_enthalpy(
+    path: str,
+    elements_list: Sequence[float],
+    pure_elements_energy: Dict[float, float],
+    total_energy: float,
+    atoms: np.ndarray,
+):
+    """Formation enthalpy of one binary-alloy sample.
+
+    Returns (composition_of_element1, total_energy, linear_mixing_energy,
+    formation_enthalpy, entropy) exactly like the reference
+    (convert_total_energy_to_formation_gibbs.py:143-183). `path` is only used in
+    error messages.
+    """
+    try:
+        counts = _element_counts(atoms, elements_list)
+    except ValueError as err:
+        raise AssertionError(f"Sample {path}: {err}") from err
+
+    ordered = sorted(elements_list)
+    num_atoms = atoms.shape[0]
+    composition = counts[0] / num_atoms
+
+    linear_mixing_energy = num_atoms * (
+        pure_elements_energy[ordered[0]] * composition
+        + pure_elements_energy[ordered[1]] * (1.0 - composition)
+    )
+    formation_enthalpy = total_energy - linear_mixing_energy
+
+    entropy = KB_RYDBERG_PER_KELVIN * _log_binomial(num_atoms, int(counts[0]))
+    return composition, total_energy, linear_mixing_energy, formation_enthalpy, entropy
+
+
+def convert_raw_data_energy_to_gibbs(
+    dir: str,
+    elements_list: Sequence[float],
+    temperature_kelvin: float = 0,
+    overwrite_data: bool = False,
+    create_plots: bool = True,
+):
+    """Rewrite every LSMS file in ``dir`` into ``<dir>_gibbs_energy/`` with the
+    header total energy replaced by the formation Gibbs free energy.
+
+    Binary alloys only: the directory must contain exactly two pure-element
+    configurations, whose per-atom energies anchor the linear mixing line.
+    Returns the array of formation Gibbs energies (one per file, in listdir
+    order) so callers/tests can inspect the result without re-parsing.
+    """
+    dir = dir.rstrip("/")
+    new_dir = dir + "_gibbs_energy/"
+    if os.path.exists(new_dir) and overwrite_data:
+        shutil.rmtree(new_dir)
+    os.makedirs(new_dir, exist_ok=True)
+
+    elements_list = sorted(elements_list)
+    all_files = sorted(os.listdir(dir))
+
+    # Pass 1: per-atom energies of the two pure-element configurations.
+    pure_elements_energy: Dict[float, float] = {}
+    for filename in all_files:
+        energy_token, _, atoms = _read_lsms_file(os.path.join(dir, filename))
+        species = np.unique(atoms[:, 0])
+        if len(species) == 1:
+            pure_elements_energy[species[0]] = float(energy_token) / atoms.shape[0]
+    assert len(pure_elements_energy) == 2, "Must have two single element files."
+
+    # Pass 2: enthalpy → Gibbs, rewrite header, collect plot series.
+    n = len(all_files)
+    total_e = np.empty(n)
+    linear_e = np.empty(n)
+    comp = np.empty(n)
+    enthalpy = np.empty(n)
+    gibbs = np.empty(n)
+    for i, filename in enumerate(all_files):
+        path = os.path.join(dir, filename)
+        energy_token, lines, atoms = _read_lsms_file(path)
+        comp[i], total_e[i], linear_e[i], enthalpy[i], entropy = (
+            compute_formation_enthalpy(
+                path, elements_list, pure_elements_energy,
+                float(energy_token), atoms,
+            )
+        )
+        gibbs[i] = enthalpy[i] - temperature_kelvin * entropy
+
+        lines[0] = lines[0].replace(energy_token, str(gibbs[i]))
+        with open(os.path.join(new_dir, filename), "w") as fh:
+            fh.write("".join(lines))
+
+    print("Min formation enthalpy: ", gibbs.min())
+    print("Max formation enthalpy: ", gibbs.max())
+
+    if create_plots:
+        _scatter_plots(
+            [
+                (total_e, linear_e, "Total energy (Rydberg)",
+                 "Linear mixing energy (Rydberg)", "linear_mixing_energy.png"),
+                (comp, enthalpy, "Concentration",
+                 "Formation enthalpy (Rydberg)", "formation_enthalpy.png"),
+                (comp, gibbs, "Concentration",
+                 "Formation Gibbs energy (Rydberg)", "formation_gibbs_energy.png"),
+            ]
+        )
+    return gibbs
+
+
+def _scatter_plots(specs):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    for x, y, xlabel, ylabel, fname in specs:
+        fig, ax = plt.subplots()
+        ax.scatter(x, y, edgecolor="b", facecolor="none")
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel(ylabel)
+        fig.savefig(fname)
+        plt.close(fig)
+
+
+def compositional_histogram_cutoff(
+    dir: str,
+    elements_list: Sequence[float],
+    histogram_cutoff: int,
+    num_bins: int,
+    overwrite_data: bool = False,
+    create_plots: bool = True,
+):
+    """Downselect LSMS data to at most ``histogram_cutoff`` samples per binary
+    composition bin; survivors are symlinked into ``<dir>_histogram_cutoff/``
+    (reference compositional_histogram_cutoff.py:16-75).
+    """
+    dir = dir.rstrip("/")
+    new_dir = dir + "_histogram_cutoff/"
+    if os.path.exists(new_dir):
+        if overwrite_data:
+            shutil.rmtree(new_dir)
+        else:
+            print("Exiting: path to histogram cutoff data already exists")
+            return
+    os.makedirs(new_dir, exist_ok=True)
+
+    bin_edges = np.linspace(0.0, 1.0, num_bins)
+    kept_compositions = []
+    bin_counts = np.zeros(num_bins, dtype=np.int64)
+    for filename in sorted(os.listdir(dir)):
+        path = os.path.join(dir, filename)
+        atoms = np.loadtxt(path, skiprows=1, ndmin=2)
+        counts = _element_counts(atoms, elements_list)
+        composition = counts[0] / atoms.shape[0]
+
+        # Interior-point binning matching the reference's find_bin: edge values
+        # (including the pure compositions 0 and 1) fall into the last bin.
+        hit = np.nonzero(
+            (composition > bin_edges[:-1]) & (composition < bin_edges[1:])
+        )[0]
+        b = int(hit[0]) if hit.size else num_bins - 1
+
+        bin_counts[b] += 1
+        if bin_counts[b] < histogram_cutoff:
+            kept_compositions.append(composition)
+            os.symlink(os.path.abspath(path), os.path.join(new_dir, filename))
+
+    if create_plots:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        ax.hist(kept_compositions, bins=num_bins)
+        fig.savefig("composition_histogram_cutoff.png")
+        plt.close(fig)
+
+        fig, ax = plt.subplots()
+        ax.bar(np.linspace(0, 1, num_bins), bin_counts, width=1.0 / num_bins)
+        fig.savefig("composition_initial.png")
+        plt.close(fig)
+
+    return np.asarray(kept_compositions), bin_counts

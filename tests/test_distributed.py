@@ -9,11 +9,9 @@ import pytest
 
 from hydragnn_tpu.graphs import GraphSample, collate_graphs
 from hydragnn_tpu.models import create_model, init_model_variables
-from hydragnn_tpu.models.create import create_model as _create
 from hydragnn_tpu.parallel import make_mesh
 from hydragnn_tpu.train.trainer import (
     create_train_state,
-    make_eval_step,
     make_eval_step_dp,
     make_train_step,
     make_train_step_dp,

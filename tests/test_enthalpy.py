@@ -1,0 +1,37 @@
+"""Formation enthalpy of linear synthetic data must be exactly zero
+(reference tests/test_enthalpy.py:22-66): when every sample's total energy is a
+linear function of composition, subtracting the linear mixing line leaves 0."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.tools import convert_raw_data_energy_to_gibbs
+from tests.deterministic_graph_data import deterministic_graph_data
+
+
+@pytest.mark.mpi_skip()
+def pytest_formation_enthalpy(tmp_path):
+    dir = str(tmp_path / "unit_test_enthalpy")
+    os.makedirs(dir, exist_ok=True)
+
+    num_config = 10
+    deterministic_graph_data(dir, num_config, number_types=2, linear_only=True)
+    # Pure-element configurations anchor the linear mixing line.
+    deterministic_graph_data(
+        dir, number_configurations=1, configuration_start=num_config,
+        number_types=1, types=[0], linear_only=True,
+    )
+    deterministic_graph_data(
+        dir, number_configurations=1, configuration_start=num_config + 1,
+        number_types=1, types=[1], linear_only=True,
+    )
+
+    gibbs = convert_raw_data_energy_to_gibbs(dir, [0, 1], create_plots=False)
+    assert np.allclose(gibbs, 0.0)
+
+    new_dir = dir + "_gibbs_energy"
+    for filename in os.listdir(new_dir):
+        enthalpy = np.loadtxt(os.path.join(new_dir, filename), max_rows=1)
+        assert enthalpy == 0

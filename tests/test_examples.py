@@ -1,0 +1,42 @@
+"""Example smoke tests (reference tests/test_examples.py:18-26): run each
+example script in a subprocess and require exit 0. The wrapper forces JAX onto
+host CPU before the example imports jax (the env pins an external platform that
+can only be overridden in-process)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WRAPPER = """
+import os
+os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import runpy
+runpy.run_path({script!r}, run_name='__main__')
+"""
+
+
+@pytest.mark.parametrize(
+    "example",
+    ["qm9", "md17", "lsms", "eam", os.path.join("ising_model", "ising_model")],
+)
+@pytest.mark.mpi_skip()
+def pytest_examples(example):
+    if os.sep not in example:
+        example = os.path.join(example, example)
+    script = os.path.join(_REPO, "examples", example + ".py")
+    code = _WRAPPER.format(script=script)
+    result = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    assert result.returncode == 0, (
+        f"{example} failed:\n{result.stdout[-2000:]}\n{result.stderr[-2000:]}"
+    )

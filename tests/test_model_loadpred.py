@@ -1,0 +1,108 @@
+"""Checkpoint-reload integration test (reference tests/test_model_loadpred.py:
+18-98): train if no saved model exists, then build a FRESH model, restore the
+checkpoint from disk, and assert prediction quality — test-set MAE < 0.2 per
+head and per-sample max-abs error < 0.75."""
+
+import json
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import hydragnn_tpu as hydragnn
+from hydragnn_tpu.graphs.collate import collate_graphs
+from tests.test_graphs import unittest_train_model
+
+THRESHOLDS = [0.2, 0.75]  # [test-set MAE, single-sample max-abs error]
+
+
+def unittest_model_prediction(config):
+    hydragnn.parallel.setup_ddp()
+    train_loader, val_loader, test_loader, _ = (
+        hydragnn.preprocess.dataset_loading_and_splitting(config=config)
+    )
+    config = hydragnn.utils.update_config(
+        config, train_loader, val_loader, test_loader
+    )
+
+    # Fresh model + restored checkpoint — exercising load_existing_model, not
+    # the weights already in memory.
+    model = hydragnn.models.create_model_config(
+        config=config["NeuralNetwork"]["Architecture"],
+        verbosity=config["Verbosity"]["level"],
+    )
+    variables = hydragnn.models.init_model_variables(
+        model, next(iter(test_loader))
+    )
+    log_name = hydragnn.utils.get_log_name_config(config)
+    variables, _ = hydragnn.utils.load_existing_model(variables, log_name)
+
+    optimizer = hydragnn.utils.select_optimizer("AdamW", 1e-3)
+    state = hydragnn.train.create_train_state(model, variables, optimizer)
+    driver = hydragnn.train.TrainingDriver(model, optimizer, state)
+
+    _, _, true_values, predicted_values = driver.evaluate(
+        test_loader, return_values=True
+    )
+
+    # Single randomly-selected sample through the forward pass.
+    isample = random.randrange(len(test_loader.dataset))
+    sample = test_loader.dataset[isample]
+    single = collate_graphs(
+        [sample],
+        model.output_type,
+        list(model.output_dim),
+        edge_dim=test_loader.edge_dim,
+    )
+    _, outputs = driver.eval_step(driver.state, single)
+
+    for ihead in range(len(true_values)):
+        head_true = np.asarray(true_values[ihead])
+        head_pred = np.asarray(predicted_values[ihead])
+        test_mae = np.abs(head_true - head_pred).mean()
+        print("For head", ihead, "; MAE of test set =", test_mae)
+        assert test_mae < THRESHOLDS[0], "MAE sample checking failed for test set!"
+
+        htype = model.output_type[ihead]
+        mask = np.asarray(
+            single.graph_mask if htype == "graph" else single.node_mask
+        ).reshape(-1)
+        pred = np.asarray(outputs[ihead]).reshape(len(mask), -1)[mask]
+        tgt = np.asarray(single.targets[ihead]).reshape(len(mask), -1)[mask]
+        error = float(np.abs(tgt - pred).max())
+        print("For head", ihead, "; max|true-predicted| =", error)
+        assert error < THRESHOLDS[1], (
+            f"Error checking failed for test sample {isample}"
+        )
+
+
+@pytest.mark.mpi_skip()
+def pytest_model_loadpred():
+    model_type = "PNA"
+    config_file = os.path.join(os.getcwd(), "tests/inputs", "ci_multihead.json")
+    with open(config_file, "r") as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Architecture"]["model_type"] = model_type
+
+    # Reuse a previously trained model + serialized data when present
+    # (reference test_model_loadpred.py:77-97), else train one now.
+    log_name = hydragnn.utils.get_log_name_config(config)
+    modelfile = os.path.join("./logs/", log_name, log_name + ".pk")
+    snapshot = os.path.join("./logs/", log_name, "config.json")
+    case_exist = os.path.isfile(modelfile) and os.path.isfile(snapshot)
+    if case_exist:
+        with open(snapshot, "r") as f:
+            config = json.load(f)
+        for _, raw_data_path in config["Dataset"]["path"].items():
+            if not os.path.isfile(raw_data_path):
+                case_exist = False
+                break
+    if not case_exist:
+        unittest_train_model(model_type, "ci_multihead.json", False)
+        with open(snapshot, "r") as f:
+            config = json.load(f)
+    unittest_model_prediction(config)

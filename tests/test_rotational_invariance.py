@@ -3,6 +3,9 @@ NormalizeRotation (reference /root/reference/tests/test_rotational_invariance.py
 52-116): edge sets and lengths must match between a structure and any rigid
 rotation of it, tol 1e-4 fp32 / 1e-14 fp64 (host-side numpy is float64)."""
 
+import json
+import os
+
 import numpy as np
 
 from hydragnn_tpu.graphs.sample import GraphSample
@@ -11,6 +14,11 @@ from hydragnn_tpu.preprocess.graph_build import (
     compute_edges,
     normalize_rotation,
 )
+
+with open(
+    os.path.join(os.path.dirname(__file__), "inputs", "ci_rotational_invariance.json")
+) as _f:
+    _ARCH = json.load(_f)["Architecture"]
 
 
 def _rotation_matrix(rng):
@@ -32,7 +40,7 @@ def _edge_set_with_lengths(sample):
 
 
 def unittest_rotational_invariance(pos, tol):
-    radius, max_neigh = 1.5, 20
+    radius, max_neigh = _ARCH["radius"], _ARCH["max_neighbours"]
 
     def build(p):
         s = GraphSample(x=np.ones((len(p), 1)), pos=np.array(p, dtype=np.float64))
