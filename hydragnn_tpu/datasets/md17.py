@@ -64,9 +64,14 @@ def load_md17(
         path = os.path.join(root, candidate)
         if os.path.exists(path):
             data = np.load(path)
-            R, z = data["R"], data["z"]
+            # sGDML files use R/z/E/F; revised-MD17 (rMD17) archives use
+            # coords/nuclear_charges/energies/forces.
+            R = data["R"] if "R" in data else data["coords"]
+            z = data["z"] if "z" in data else data["nuclear_charges"]
             E = data["E"] if "E" in data else data["energies"]
-            F = data["F"] if "F" in data else None
+            if E.ndim == 1:
+                E = E.reshape(-1, 1)
+            F = data["F"] if "F" in data else data.get("forces")
             if num_samples is not None:
                 R, E = R[:num_samples], E[:num_samples]
                 F = F[:num_samples] if F is not None else None

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from ..ops import segment as seg
+from ..ops import pallas_segment, segment as seg
 
 
 class SAGEConv(nn.Module):
@@ -186,21 +186,14 @@ class PNAConv(nn.Module):
         z = jnp.concatenate(z, axis=-1)
         msg = nn.Dense(f, name="pre_nn")(z)  # [E, f]
 
-        aggs = []
-        for a in self.aggregators:
-            if a == "mean":
-                aggs.append(seg.segment_mean(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
-            elif a == "min":
-                aggs.append(seg.segment_min(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
-            elif a == "max":
-                aggs.append(seg.segment_max(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
-            elif a == "std":
-                aggs.append(seg.segment_std(msg, receivers, n, mask=edge_mask, axis_name=self.axis_name))
-            else:
-                raise ValueError(f"Unknown aggregator {a}")
-        agg = jnp.stack(aggs, axis=1)  # [N, A, f]
+        # Fused Pallas moments kernel on TPU (one pass over msg for mean/std),
+        # masked XLA segment ops elsewhere — see ops/pallas_segment.py.
+        agg, deg = pallas_segment.pna_aggregate(
+            msg, receivers, n, self.aggregators,
+            mask=edge_mask, axis_name=self.axis_name,
+        )  # agg: [N, A, f]
 
-        deg = jnp.maximum(seg.segment_count(receivers, n, mask=edge_mask, axis_name=self.axis_name), 1.0)
+        deg = jnp.maximum(deg, 1.0)
         log_deg = jnp.log(deg + 1.0)
         scales = []
         for s in self.scalers:

@@ -1,1 +1,1 @@
-from . import segment
+from . import pallas_segment, segment
