@@ -1,0 +1,233 @@
+// Native cell-list neighbor-list builder — the C++ replacement for the
+// torch-cluster RadiusGraph / ase.neighborlist.neighbor_list native kernels the
+// reference leans on (/root/reference/hydragnn/preprocess/utils.py:51-123).
+// Host-side graph construction is the data-pipeline hot loop (SURVEY.md §3.6);
+// it stays out of the XLA graph and feeds the padded-batch collator.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in the image). Semantics match
+// hydragnn_tpu/preprocess/graph_build.py exactly:
+//  - flat: edges (j → i) with |p_i - p_j| <= radius, nearest-first per
+//    receiver, capped at max_neighbours, ties broken by source index.
+//  - periodic: pairs over all cell images within the cutoff (an atom sees its
+//    own periodic copy); duplicate (i, j) pairs signal an inconsistent
+//    radius/cell combination (error -2, mirroring the reference's assert).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 neighborlist.cc -o _neighborlist.so
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Nbr {
+  double d2;
+  int64_t j;
+};
+
+inline bool nbr_less(const Nbr& a, const Nbr& b) {
+  if (a.d2 != b.d2) return a.d2 < b.d2;
+  return a.j < b.j;
+}
+
+// Cell grid with edge >= radius: all neighbors of a point within `radius` lie
+// in the 27-cell stencil around its (clamped) cell — including points up to
+// one cell-length outside the bounding box.
+struct CellGrid {
+  double lo[3], hi[3];
+  int64_t dims[3];
+  std::vector<int64_t> head, next;
+
+  CellGrid(const double* pos, int64_t n, double radius) {
+    for (int k = 0; k < 3; ++k) lo[k] = hi[k] = pos[k];
+    for (int64_t i = 1; i < n; ++i)
+      for (int k = 0; k < 3; ++k) {
+        lo[k] = std::min(lo[k], pos[3 * i + k]);
+        hi[k] = std::max(hi[k], pos[3 * i + k]);
+      }
+    const int64_t dim_cap =
+        std::max<int64_t>(1, (int64_t)std::ceil(std::cbrt((double)n))) + 1;
+    for (int k = 0; k < 3; ++k) {
+      double extent = hi[k] - lo[k];
+      int64_t d = radius > 0 ? (int64_t)std::floor(extent / radius) : 1;
+      dims[k] = std::max<int64_t>(1, std::min(d, dim_cap));
+    }
+    head.assign(dims[0] * dims[1] * dims[2], -1);
+    next.assign(n, -1);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t c = cell_of(pos + 3 * i);
+      next[i] = head[c];
+      head[c] = i;
+    }
+  }
+
+  int64_t coord(const double* p, int k) const {
+    double extent = hi[k] - lo[k];
+    int64_t c = extent > 0
+                    ? (int64_t)((p[k] - lo[k]) / extent * (double)dims[k])
+                    : 0;
+    return std::min(std::max<int64_t>(c, 0), dims[k] - 1);
+  }
+
+  int64_t cell_of(const double* p) const {
+    return (coord(p, 0) * dims[1] + coord(p, 1)) * dims[2] + coord(p, 2);
+  }
+
+  // Visit every point j with |pos_j - q| <= radius (squared test via r2).
+  template <typename F>
+  void for_neighbors(const double* pos, const double* q, double r2,
+                     F&& fn) const {
+    int64_t cx = coord(q, 0), cy = coord(q, 1), cz = coord(q, 2);
+    for (int64_t dx = -1; dx <= 1; ++dx)
+      for (int64_t dy = -1; dy <= 1; ++dy)
+        for (int64_t dz = -1; dz <= 1; ++dz) {
+          int64_t x = cx + dx, y = cy + dy, z = cz + dz;
+          if (x < 0 || x >= dims[0] || y < 0 || y >= dims[1] || z < 0 ||
+              z >= dims[2])
+            continue;
+          for (int64_t j = head[(x * dims[1] + y) * dims[2] + z]; j >= 0;
+               j = next[j]) {
+            const double* pj = pos + 3 * j;
+            double d2 = 0;
+            for (int k = 0; k < 3; ++k) {
+              double diff = q[k] - pj[k];
+              d2 += diff * diff;
+            }
+            if (d2 <= r2) fn(j, d2);
+          }
+        }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns edge count, or -1 if `cap` is too small.
+int64_t hg_radius_graph_flat(const double* pos, int64_t n, double radius,
+                             int64_t max_neighbours, int loop,
+                             int64_t* senders, int64_t* receivers,
+                             int64_t cap) {
+  if (n == 0) return 0;
+  const double r2 = radius * radius;
+  CellGrid grid(pos, n, radius);
+
+  int64_t count = 0;
+  std::vector<Nbr> nbrs;
+  for (int64_t i = 0; i < n; ++i) {
+    nbrs.clear();
+    grid.for_neighbors(pos, pos + 3 * i, r2, [&](int64_t j, double d2) {
+      if (j == i && !loop) return;
+      nbrs.push_back({d2, j});
+    });
+    std::sort(nbrs.begin(), nbrs.end(), nbr_less);
+    int64_t keep = std::min<int64_t>((int64_t)nbrs.size(), max_neighbours);
+    if (count + keep > cap) return -1;
+    for (int64_t k = 0; k < keep; ++k) {
+      senders[count] = nbrs[k].j;
+      receivers[count] = i;
+      ++count;
+    }
+  }
+  return count;
+}
+
+// Periodic neighbor list over cell images. `cell` is row-major 3x3.
+// Returns edge count; -1 if cap too small; -2 on duplicate (i, j) pairs
+// (radius inconsistent with cell size — reference preprocess/utils.py:108-116).
+int64_t hg_radius_graph_pbc(const double* pos, int64_t n, const double* cell,
+                            double radius, int64_t max_neighbours, int loop,
+                            int64_t* senders, int64_t* receivers,
+                            double* lengths, int64_t cap) {
+  if (n == 0) return 0;
+  const double r2 = radius * radius;
+
+  // Image search range per axis from the cell heights (volume / face area).
+  double vol = cell[0] * (cell[4] * cell[8] - cell[5] * cell[7]) -
+               cell[1] * (cell[3] * cell[8] - cell[5] * cell[6]) +
+               cell[2] * (cell[3] * cell[7] - cell[4] * cell[6]);
+  vol = std::fabs(vol);
+  int64_t nimg[3];
+  for (int k = 0; k < 3; ++k) {
+    const double* a = cell + 3 * ((k + 1) % 3);
+    const double* b = cell + 3 * ((k + 2) % 3);
+    double cx = a[1] * b[2] - a[2] * b[1];
+    double cy = a[2] * b[0] - a[0] * b[2];
+    double cz = a[0] * b[1] - a[1] * b[0];
+    double height = vol / std::sqrt(cx * cx + cy * cy + cz * cz);
+    nimg[k] = (int64_t)std::ceil(radius / height);
+  }
+
+  struct Edge {
+    int64_t src, dst;
+    double len;
+  };
+  std::vector<Edge> edges;
+  std::unordered_set<int64_t> seen;
+  bool duplicate = false;
+  CellGrid grid(pos, n, radius);
+
+  // Pairs (i, j) with |pos_i - pos_j - offset| <= radius ⇔ atoms j within
+  // `radius` of the query point pos_i - offset; the grid prunes both the
+  // per-atom scan and (via the bbox test) whole off-boundary image passes.
+  for (int64_t si = -nimg[0]; si <= nimg[0]; ++si)
+    for (int64_t sj = -nimg[1]; sj <= nimg[1]; ++sj)
+      for (int64_t sk = -nimg[2]; sk <= nimg[2]; ++sk) {
+        double off[3];
+        for (int k = 0; k < 3; ++k)
+          off[k] = si * cell[0 + k] + sj * cell[3 + k] + sk * cell[6 + k];
+        bool zero_shift = (si == 0 && sj == 0 && sk == 0);
+        for (int64_t i = 0; i < n; ++i) {
+          double q[3];
+          bool outside = false;
+          for (int k = 0; k < 3; ++k) {
+            q[k] = pos[3 * i + k] - off[k];
+            outside |= q[k] < grid.lo[k] - radius || q[k] > grid.hi[k] + radius;
+          }
+          if (outside) continue;
+          grid.for_neighbors(pos, q, r2, [&](int64_t j, double d2) {
+            if (zero_shift && i == j && !loop) return;
+            if (!seen.insert(i * n + j).second) duplicate = true;
+            edges.push_back({j, i, std::sqrt(d2)});
+          });
+        }
+      }
+  if (duplicate) return -2;
+
+  std::vector<int64_t> keep;
+  if (max_neighbours >= 0) {
+    // Per-receiver nearest-first cap (stable on original edge order), output
+    // in original edge order — mirrors graph_build._cap_neighbors.
+    std::vector<std::vector<int64_t>> by_recv(n);
+    for (int64_t e = 0; e < (int64_t)edges.size(); ++e)
+      by_recv[edges[e].dst].push_back(e);
+    for (int64_t r = 0; r < n; ++r) {
+      auto& es = by_recv[r];
+      if ((int64_t)es.size() > max_neighbours) {
+        std::stable_sort(es.begin(), es.end(), [&](int64_t a, int64_t b) {
+          return edges[a].len < edges[b].len;
+        });
+        es.resize(max_neighbours);
+      }
+      keep.insert(keep.end(), es.begin(), es.end());
+    }
+    std::sort(keep.begin(), keep.end());
+  } else {
+    keep.resize(edges.size());
+    for (int64_t e = 0; e < (int64_t)edges.size(); ++e) keep[e] = e;
+  }
+
+  if ((int64_t)keep.size() > cap) return -1;
+  int64_t count = 0;
+  for (int64_t e : keep) {
+    senders[count] = edges[e].src;
+    receivers[count] = edges[e].dst;
+    lengths[count] = edges[e].len;
+    ++count;
+  }
+  return count;
+}
+
+}  // extern "C"

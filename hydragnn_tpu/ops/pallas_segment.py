@@ -198,13 +198,15 @@ def _sum_count_bwd(num_segments, interpret, split, res, cots):
 segment_sum_count.defvjp(_sum_count_fwd, _sum_count_bwd)
 
 
-def _stats_forward(data, ids, num_segments, eps, axis_name, interpret):
+def _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std):
     total, count = segment_sum_count(data, ids, num_segments, interpret, True)
     if axis_name is not None:
         total = jax.lax.psum(total, axis_name)
         count = jax.lax.psum(count, axis_name)
     safe = jnp.maximum(count, 1.0)[:, None]
     mean = total / safe
+    if not want_std:
+        return total, mean, jnp.zeros_like(mean), count
     # Centered second pass: squares are positive (no cancellation), so the
     # cheap single-pass bf16 matmul suffices.
     idx = jnp.clip(ids, 0, num_segments - 1)
@@ -218,18 +220,18 @@ def _stats_forward(data, ids, num_segments, eps, axis_name, interpret):
     return total, mean, std, count
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _stats(data, ids, num_segments, eps, axis_name, interpret):
-    return _stats_forward(data, ids, num_segments, eps, axis_name, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _stats(data, ids, num_segments, eps, axis_name, interpret, want_std):
+    return _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std)
 
 
-def _stats_fwd(data, ids, num_segments, eps, axis_name, interpret):
-    out = _stats_forward(data, ids, num_segments, eps, axis_name, interpret)
+def _stats_fwd(data, ids, num_segments, eps, axis_name, interpret, want_std):
+    out = _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std)
     total, mean, std, count = out
     return out, (data, ids, mean, std, count)
 
 
-def _stats_bwd(num_segments, eps, axis_name, interpret, res, cots):
+def _stats_bwd(num_segments, eps, axis_name, interpret, want_std, res, cots):
     """Analytic scatter-free backward. With s=Σx, μ=s/n, σ=sqrt(Σ(x-μ)²/n+eps):
     since Σ_e (x_e - μ) = 0 exactly, the μ-coupling inside σ vanishes and
 
@@ -247,13 +249,15 @@ def _stats_bwd(num_segments, eps, axis_name, interpret, res, cots):
         d_std = jax.lax.psum(d_std, axis_name)
     safe = jnp.maximum(count, 1.0)[:, None]
     per_seg_lin = d_total + d_mean / safe  # [N, F]
-    # Single-element segments have x ≡ μ, so dσ/dx is identically 0; guard the
-    # 1/σ=1/sqrt(eps) amplification against residual rounding in x−μ.
-    per_seg_quad = jnp.where(count[:, None] > 1.0, d_std / (std * safe), 0.0)
     valid = (ids >= 0)[:, None]
     idx = jnp.clip(ids, 0, num_segments - 1)
-    centered = data - mean[idx]
-    d_data = jnp.where(valid, per_seg_lin[idx] + per_seg_quad[idx] * centered, 0.0)
+    d_data = per_seg_lin[idx]
+    if want_std:
+        # Single-element segments have x ≡ μ, so dσ/dx is identically 0; guard
+        # the 1/σ=1/sqrt(eps) amplification against residual rounding in x−μ.
+        per_seg_quad = jnp.where(count[:, None] > 1.0, d_std / (std * safe), 0.0)
+        d_data = d_data + per_seg_quad[idx] * (data - mean[idx])
+    d_data = jnp.where(valid, d_data, 0.0)
     return d_data.astype(data.dtype), jnp.zeros(ids.shape, jax.dtypes.float0)
 
 
@@ -268,10 +272,13 @@ def fused_segment_stats(
     eps: float = 1e-5,
     axis_name: Optional[str] = None,
     interpret: Optional[bool] = None,
+    want_std: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(sum, mean, std, count) per segment from two fused passes — the PNA
     sum/mean/std aggregator family (drop-in for segment_sum + segment_mean +
     segment_std + segment_count), with an analytic scatter-free backward.
+    ``want_std=False`` skips the centered second pass (std comes back as
+    zeros) when only the sum/mean family is needed.
 
     Under edge-sharded graph parallelism (``axis_name``) the raw partial sums
     are psum'd across the shard axis before the mean/std are formed — the same
@@ -282,7 +289,7 @@ def fused_segment_stats(
         ids = jnp.where(mask, ids, -1)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _stats(data, ids, num_segments, eps, axis_name, interpret)
+    return _stats(data, ids, num_segments, eps, axis_name, interpret, want_std)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -340,7 +347,8 @@ def pna_aggregate(
         count = None
         if any(a in ("mean", "std", "sum") for a in aggregators):
             total, mean, std, count = fused_segment_stats(
-                msg, receivers, n, mask=mask, axis_name=axis_name
+                msg, receivers, n, mask=mask, axis_name=axis_name,
+                want_std="std" in aggregators,
             )
             fused = {"mean": mean, "std": std, "sum": total}
         if "min" in aggregators or "max" in aggregators:

@@ -12,13 +12,19 @@ import numpy as np
 from scipy.spatial import cKDTree
 
 from ..graphs.sample import GraphSample
+from .. import native
 
 
 def radius_graph(
     pos: np.ndarray, radius: float, max_neighbours: int, loop: bool = False
 ):
     """Edges (j → i) for all j within `radius` of i, nearest-first, capped at
-    `max_neighbours` per receiver (torch-cluster radius_graph semantics)."""
+    `max_neighbours` per receiver (torch-cluster radius_graph semantics).
+
+    Uses the native C++ cell-list builder when available (hydragnn_tpu/native),
+    falling back to the numpy/cKDTree path below."""
+    if native.available():
+        return native.radius_graph(pos, radius, max_neighbours, loop), None
     pos = np.asarray(pos, dtype=np.float64)
     tree = cKDTree(pos)
     senders, receivers = [], []
@@ -48,7 +54,12 @@ def periodic_radius_graph(
     Self-pairs across nonzero images ARE included (an atom sees its own periodic
     copy); the zero-image self pair only with loop=True. The image search range per
     axis is ceil(radius / cell-height) with cell heights from the reciprocal cell.
+
+    Uses the native C++ builder when available (hydragnn_tpu/native), falling
+    back to the numpy/cKDTree path below.
     """
+    if native.available():
+        return native.periodic_radius_graph(pos, cell, radius, max_neighbours, loop)
     pos = np.asarray(pos, dtype=np.float64)
     cell = np.asarray(cell, dtype=np.float64).reshape(3, 3)
     n = pos.shape[0]

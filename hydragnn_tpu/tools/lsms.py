@@ -213,7 +213,7 @@ def compositional_histogram_cutoff(
             shutil.rmtree(new_dir)
         else:
             print("Exiting: path to histogram cutoff data already exists")
-            return [], np.zeros(num_bins, dtype=np.int64)
+            return np.asarray([]), np.zeros(num_bins, dtype=np.int64)
     os.makedirs(new_dir, exist_ok=True)
 
     bin_edges = np.linspace(0.0, 1.0, num_bins)

@@ -84,13 +84,17 @@ def pytest_pna_aggregate_fallback_matches_fused():
 
     import os
 
-    os.environ["HYDRAGNN_PALLAS"] = "1"
+    saved = os.environ.get("HYDRAGNN_PALLAS")
     try:
+        os.environ["HYDRAGNN_PALLAS"] = "1"
         agg_fused, cnt_fused = ps.pna_aggregate(data, ids, n, aggregators, mask=mask)
-    finally:
         os.environ["HYDRAGNN_PALLAS"] = "0"
-    agg_xla, cnt_xla = ps.pna_aggregate(data, ids, n, aggregators, mask=mask)
-    del os.environ["HYDRAGNN_PALLAS"]
+        agg_xla, cnt_xla = ps.pna_aggregate(data, ids, n, aggregators, mask=mask)
+    finally:
+        if saved is None:
+            os.environ.pop("HYDRAGNN_PALLAS", None)
+        else:
+            os.environ["HYDRAGNN_PALLAS"] = saved
     np.testing.assert_allclose(agg_fused, agg_xla, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(cnt_fused, cnt_xla, rtol=1e-6)
 
