@@ -1,13 +1,22 @@
 """Result plotting (reference /root/reference/hydragnn/postprocess/visualizer.py:
-24-735): parity/scatter plots per head, error histograms, loss-history dump
-(pickled ``history_loss.pkl``) + curves, node-count histogram. matplotlib with the
-Agg backend — file output only."""
+24-735): per-head parity/scatter plots (scalar, vector, per-node), error
+histograms, conditional-mean / error-PDF "global analysis", loss-history pickle
++ curves, and the test-set graph-size histogram. matplotlib with the Agg
+backend — file output only.
+
+The reference stores node-level head values as python lists-of-lists indexed
+[sample][node] (which assumes a fixed graph size for the per-node plots,
+visualizer.py:280-383). Here eval produces flat ``[rows, dim]`` arrays; node
+heads are folded back to ``[samples, nodes]`` when the test set has a constant
+graph size, and fall back to aggregate (scalar-style) plots otherwise — same
+outputs where the reference works at all, no crash where it would."""
 
 from __future__ import annotations
 
+import math
 import os
 import pickle
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import matplotlib
 
@@ -16,109 +25,361 @@ import matplotlib.pyplot as plt
 import numpy as np
 
 
+def _identity_line(ax):
+    lo = max(ax.get_xlim()[0], ax.get_ylim()[0])
+    hi = min(ax.get_xlim()[1], ax.get_ylim()[1])
+    ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
+
+
+def _grid(n_panels: int, panel_w=3.0, panel_h=3.0):
+    nrow = max(1, math.floor(math.sqrt(n_panels)))
+    ncol = math.ceil(n_panels / nrow)
+    fig, axs = plt.subplots(nrow, ncol, figsize=(ncol * panel_w, nrow * panel_h))
+    axs = np.atleast_1d(axs).flatten()
+    for ax in axs[n_panels:]:
+        ax.axis("off")
+    return fig, axs
+
+
 class Visualizer:
     def __init__(
         self,
-        model_with_config_name: str,
+        output_dir: str,
         node_feature: Sequence = (),
+        num_nodes_list: Sequence[int] = (),
         num_heads: int = 1,
         head_dims: Sequence[int] = (1,),
+        head_types: Optional[Sequence[str]] = None,
     ):
-        self.true_values = []
-        self.predicted_values = []
-        self.model_with_config_name = model_with_config_name
-        os.makedirs(self.model_with_config_name, exist_ok=True)
-        self.node_feature = node_feature
+        self.output_dir = output_dir
+        os.makedirs(self.output_dir, exist_ok=True)
+        # Flat per-node input features of the test set, [total_nodes] (the
+        # reference collects data.x.tolist() per sample,
+        # train_validate_test.py:62-66).
+        self.node_feature = np.asarray(node_feature, dtype=np.float64).reshape(-1)
+        self.num_nodes_list = [int(n) for n in num_nodes_list]
         self.num_heads = num_heads
         self.head_dims = list(head_dims)
+        self.head_types = list(head_types) if head_types else ["graph"] * num_heads
+
+    # back-compat alias (first-round API)
+    @property
+    def model_with_config_name(self):
+        return self.output_dir
+
+    def _path(self, stem: str, iepoch=None) -> str:
+        if iepoch is not None and iepoch >= 0:
+            stem = f"{stem}_{str(iepoch).zfill(4)}"
+        return os.path.join(self.output_dir, stem + ".png")
+
+    def _fixed_graph_size(self) -> Optional[int]:
+        sizes = set(self.num_nodes_list)
+        return sizes.pop() if len(sizes) == 1 else None
+
+    def _fold_nodes(self, values: np.ndarray) -> Optional[np.ndarray]:
+        """[total_nodes] → [samples, nodes] when graph size is constant."""
+        n = self._fixed_graph_size()
+        flat = np.asarray(values).reshape(-1)
+        if n and flat.size % n == 0:
+            return flat.reshape(-1, n)
+        return None
+
+    # ------------------------------------------------------------- primitives
+    def _scatter(self, ax, x, y, s=None, c=None, marker=None, title=None,
+                 x_label=None, y_label=None, xylim_equal=False):
+        x = np.asarray(x).reshape(-1)
+        y = np.asarray(y).reshape(-1)
+        if c is not None:
+            ax.scatter(x, y, s=s, c=np.asarray(c).reshape(-1), marker=marker)
+        else:
+            ax.scatter(x, y, s=s, edgecolor="b", marker=marker, facecolor="none")
+        ax.set_title(title)
+        ax.set_xlabel(x_label)
+        ax.set_ylabel(y_label)
+        if xylim_equal:
+            ax.set_aspect("equal")
+            lo = min(ax.get_xlim()[0], ax.get_ylim()[0])
+            hi = max(ax.get_xlim()[1], ax.get_ylim()[1])
+            ax.set_xlim(lo, hi)
+            ax.set_ylim(lo, hi)
+        _identity_line(ax)
+
+    @staticmethod
+    def _condmean(true, pred, weight=1.0, bins=50):
+        """<weight·|true−pred|> conditioned on true, binned (reference
+        __err_condmean, visualizer.py:93-105)."""
+        true = np.asarray(true).reshape(-1)
+        err = np.abs(true - np.asarray(pred).reshape(-1)) * weight
+        sums, edges = np.histogram(true, bins=bins, weights=err)
+        counts, _ = np.histogram(true, bins=bins)
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        return centers, sums / np.maximum(counts, 1)
+
+    @staticmethod
+    def _error_pdf(true, pred, bins=40):
+        hist, edges = np.histogram(
+            np.asarray(pred).reshape(-1) - np.asarray(true).reshape(-1),
+            bins=bins, density=True,
+        )
+        return 0.5 * (edges[:-1] + edges[1:]), hist
+
+    def _pdf_panel(self, ax, true, pred, title=None):
+        centers, pdf = self._error_pdf(true, pred)
+        ax.plot(centers, pdf, "ro")
+        ax.set_title(title)
+        ax.set_xlabel("Error")
+        ax.set_ylabel("PDF")
+
+    def _condmean_panel(self, ax, true, pred, weight=1.0, title=None):
+        xs, err = self._condmean(true, pred, weight)
+        ax.plot(xs, err, "ro")
+        ax.set_title(title)
+        ax.set_xlabel("True")
+        ax.set_ylabel("abs. error")
+
+    # -------------------------------------------------------- global analysis
+    def create_plot_global_analysis(self, varname, true_values, predicted_values,
+                                    save_plot=True):
+        """Scatter / conditional-mean / error-PDF triptych (reference
+        visualizer.py:133-279). Node-level inputs [samples, nodes] additionally
+        analyze the per-sample l2 length, sum, and raw components (3×3)."""
+        tv = np.asarray(true_values, dtype=np.float64)
+        pv = np.asarray(predicted_values, dtype=np.float64)
+        if tv.ndim == 1 or tv.shape[1] == 1:
+            fig, axs = plt.subplots(1, 3, figsize=(15, 4.5))
+            self._scatter(axs[0], tv, pv, title="Scalar output", x_label="True",
+                          y_label="Predicted", xylim_equal=True)
+            self._condmean_panel(axs[1], tv, pv,
+                                 title="Conditional mean abs. error")
+            self._pdf_panel(axs[2], tv, pv, title="Scalar output: error PDF")
+        else:
+            ncomp = tv.shape[1]
+            fig, axs = plt.subplots(3, 3, figsize=(18, 16))
+            panels = (
+                ("length", np.linalg.norm(tv, axis=1), np.linalg.norm(pv, axis=1),
+                 1.0 / math.sqrt(ncomp)),
+                ("sum", tv.sum(axis=1), pv.sum(axis=1), 1.0 / ncomp),
+                ("components", tv, pv, 1.0),
+            )
+            for col, (label, t, p, w) in enumerate(panels):
+                self._scatter(axs[0, col], t, p, title=f"Vector output: {label}",
+                              x_label="True", y_label="Predicted", xylim_equal=True)
+                self._condmean_panel(axs[1, col], t, p, weight=w)
+                self._pdf_panel(axs[2, col], t, p)
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(self._path(varname + "_scatter_condm_err"))
+            plt.close(fig)
+
+    # ------------------------------------------------------------ parity plots
+    def create_parity_plot_and_error_histogram_scalar(
+        self, varname, true_values, predicted_values, iepoch=None, save_plot=True
+    ):
+        """Scalar heads: parity + error-PDF pair; node-level heads (fixed graph
+        size): per-node parity grid + SUM-over-nodes + mean-over-samples panels
+        colored by the input node feature (reference visualizer.py:280-383)."""
+        tv = np.asarray(true_values, dtype=np.float64)
+        pv = np.asarray(predicted_values, dtype=np.float64)
+        if tv.ndim == 1 or tv.shape[1] == 1:
+            fig, axs = plt.subplots(1, 2, figsize=(12, 6))
+            self._scatter(axs[0], tv, pv, title=varname, x_label="True",
+                          y_label="Predicted", xylim_equal=True)
+            self._pdf_panel(axs[1], tv, pv, title=varname + ": error PDF")
+        else:
+            nsamp, nnode = tv.shape
+            feat = self._fold_nodes(self.node_feature)
+            if feat is None or feat.shape != tv.shape:
+                feat = np.zeros_like(tv)
+            fig, axs = _grid(nnode + 2)
+            for inode in range(nnode):
+                self._scatter(axs[inode], tv[:, inode], pv[:, inode], s=6,
+                              c=feat[:, inode], title=f"node:{inode}",
+                              xylim_equal=True)
+            self._scatter(axs[nnode], tv.sum(axis=1), pv.sum(axis=1), s=40,
+                          c=feat.sum(axis=1), title="SUM", xylim_equal=True)
+            self._scatter(axs[nnode + 1], tv.sum(axis=0), pv.sum(axis=0), s=40,
+                          c=feat.sum(axis=0), title=f"SMP_Mean4sites:0-{nnode}",
+                          xylim_equal=True)
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(self._path(varname, iepoch))
+            plt.close(fig)
+
+    def create_error_histogram_per_node(
+        self, varname, true_values, predicted_values, iepoch=None, save_plot=True
+    ):
+        """Per-node error-PDF grid (+ SUM and per-node-total panels); no-op for
+        scalar heads (reference visualizer.py:384-463)."""
+        tv = np.asarray(true_values, dtype=np.float64)
+        pv = np.asarray(predicted_values, dtype=np.float64)
+        if tv.ndim == 1 or tv.shape[1] == 1:
+            return
+        nsamp, nnode = tv.shape
+        fig, axs = _grid(nnode + 2, 3.5, 3.2)
+        for inode in range(nnode):
+            self._pdf_panel(axs[inode], tv[:, inode], pv[:, inode],
+                            title=f"node:{inode}")
+        self._pdf_panel(axs[nnode], tv.sum(axis=1), pv.sum(axis=1), title="SUM")
+        self._pdf_panel(axs[nnode + 1], tv.sum(axis=0), pv.sum(axis=0),
+                        title=f"SMP_Mean4sites:0-{nnode}")
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(self._path(varname + "_error_hist1d", iepoch))
+            plt.close(fig)
+
+    def create_parity_plot_vector(
+        self, varname, true_values, predicted_values, head_dim, iepoch=None,
+        save_plot=True
+    ):
+        """Component-wise parity grid for vector outputs (reference
+        visualizer.py:464-515)."""
+        tv = np.asarray(true_values, dtype=np.float64).reshape(-1, head_dim)
+        pv = np.asarray(predicted_values, dtype=np.float64).reshape(-1, head_dim)
+        markers = ["o", "s", "d"]
+        fig, axs = _grid(head_dim, 4, 4)
+        for icomp in range(head_dim):
+            self._scatter(axs[icomp], tv[:, icomp], pv[:, icomp], s=6, c=None,
+                          marker=markers[icomp % 3], title=f"comp:{icomp}",
+                          xylim_equal=True)
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(self._path(varname, iepoch))
+            plt.close(fig)
+
+    def create_parity_plot_per_node_vector(
+        self, varname, true_values, predicted_values, iepoch=None, save_plot=True
+    ):
+        """Per-node parity for 3-vector node outputs (reference
+        visualizer.py:516-610; unused there, kept for API parity)."""
+        tv = np.asarray(true_values, dtype=np.float64)
+        pv = np.asarray(predicted_values, dtype=np.float64)
+        nsamp = tv.shape[0]
+        tv = tv.reshape(nsamp, -1, 3)
+        pv = pv.reshape(nsamp, -1, 3)
+        nnode = tv.shape[1]
+        feat = self._fold_nodes(self.node_feature)
+        if feat is None or feat.shape[:1] != (nsamp,):
+            feat = np.zeros((nsamp, nnode))
+        markers = ["o", "s", "d"]
+        fig, axs = _grid(nnode + 2)
+        for inode in range(nnode):
+            for icomp in range(3):
+                self._scatter(axs[inode], tv[:, inode, icomp], pv[:, inode, icomp],
+                              s=6, c=feat[:, inode], marker=markers[icomp],
+                              title=f"node:{inode}", xylim_equal=True)
+        for icomp in range(3):
+            self._scatter(axs[nnode], tv[:, :, icomp].sum(axis=1),
+                          pv[:, :, icomp].sum(axis=1), s=40, c=feat.sum(axis=1),
+                          marker=markers[icomp], title="SUM", xylim_equal=True)
+            self._scatter(axs[nnode + 1], tv[:, :, icomp].sum(axis=0),
+                          pv[:, :, icomp].sum(axis=0), s=40, c=feat.sum(axis=0),
+                          marker=markers[icomp],
+                          title=f"SMP_Mean4sites:0-{nnode}", xylim_equal=True)
+        fig.tight_layout()
+        if save_plot:
+            fig.savefig(self._path(varname, iepoch))
+            plt.close(fig)
+
+    # --------------------------------------------------------------- dispatch
+    def _head_view(self, ihead: int, values) -> np.ndarray:
+        """Per-head flat [rows, dim] → the shape each plotter expects: node
+        heads fold to [samples, nodes] when possible."""
+        arr = np.asarray(values, dtype=np.float64)
+        if self.head_types[ihead] == "node" and self.head_dims[ihead] == 1:
+            folded = self._fold_nodes(arr)
+            if folded is not None:
+                return folded
+        return arr.reshape(-1, max(self.head_dims[ihead], 1))
+
+    def create_scatter_plots(self, true_values, predicted_values,
+                             output_names=None, iepoch=None):
+        """Per-head dispatch (reference visualizer.py:689-716)."""
+        names = output_names or [f"head{i}" for i in range(self.num_heads)]
+        for ihead in range(self.num_heads):
+            tv = self._head_view(ihead, true_values[ihead])
+            pv = self._head_view(ihead, predicted_values[ihead])
+            if self.head_dims[ihead] > 1:
+                self.create_parity_plot_vector(
+                    names[ihead], tv, pv, self.head_dims[ihead], iepoch
+                )
+            else:
+                self.create_parity_plot_and_error_histogram_scalar(
+                    names[ihead], tv, pv, iepoch
+                )
+                self.create_error_histogram_per_node(names[ihead], tv, pv, iepoch)
+
+    # back-compat alias (first-round API took per-head lists directly)
+    def create_parity_plots(self, true_values, predicted_values):
+        self.create_scatter_plots(true_values, predicted_values)
+
+    def create_error_histograms(self, true_values, predicted_values):
+        for ihead in range(min(self.num_heads, len(true_values))):
+            tv = self._head_view(ihead, true_values[ihead])
+            pv = self._head_view(ihead, predicted_values[ihead])
+            self.create_error_histogram_per_node(f"head{ihead}", tv, pv)
+
+    def create_plot_global(self, true_values, predicted_values, output_names=None):
+        """Global analysis for every head (reference visualizer.py:717-726)."""
+        names = output_names or [f"head{i}" for i in range(self.num_heads)]
+        for ihead in range(self.num_heads):
+            self.create_plot_global_analysis(
+                names[ihead],
+                self._head_view(ihead, true_values[ihead]),
+                self._head_view(ihead, predicted_values[ihead]),
+            )
 
     # ----------------------------------------------------------- loss history
-    def plot_history(self, history: dict) -> None:
-        """Dump pickled history + train/val/test curves
-        (visualizer.py:626-688)."""
-        with open(
-            os.path.join(self.model_with_config_name, "history_loss.pkl"), "wb"
-        ) as f:
+    def plot_history(self, history: dict, task_weights=None, task_names=None):
+        """Pickle the history dict + plot total and per-task train/val/test
+        curves (reference visualizer.py:626-688, history_loss.pckl)."""
+        with open(os.path.join(self.output_dir, "history_loss.pkl"), "wb") as f:
             pickle.dump(history, f)
 
-        fig, axs = plt.subplots(1, 2, figsize=(12, 4.5))
-        for key, label in (
-            ("total_loss_train", "train"),
-            ("total_loss_val", "validation"),
-            ("total_loss_test", "test"),
-        ):
-            axs[0].plot(history[key], label=label)
-        axs[0].set_xlabel("epoch")
-        axs[0].set_ylabel("total loss")
-        axs[0].set_yscale("log")
-        axs[0].legend()
+        task_train = np.atleast_2d(np.asarray(history["task_loss_train"], dtype=np.float64))
+        task_val = np.atleast_2d(np.asarray(history["task_loss_val"], dtype=np.float64))
+        task_test = np.atleast_2d(np.asarray(history["task_loss_test"], dtype=np.float64))
+        num_tasks = task_train.shape[1] if task_train.size else 0
 
-        task_train = np.asarray(history["task_loss_train"])
-        if task_train.ndim == 2:
-            for ih in range(task_train.shape[1]):
-                axs[1].plot(task_train[:, ih], label=f"task {ih}")
-            axs[1].set_xlabel("epoch")
-            axs[1].set_ylabel("task RMSE (train)")
-            axs[1].set_yscale("log")
-            axs[1].legend()
+        ncol = max(num_tasks, 1)
+        nrow = 2 if num_tasks else 1
+        fig, axs = plt.subplots(nrow, ncol, figsize=(4.5 * ncol, 4.0 * nrow),
+                                squeeze=False)
+        ax = axs[0][0]
+        ax.plot(history["total_loss_train"], "-", label="train")
+        ax.plot(history["total_loss_val"], ":", label="validation")
+        ax.plot(history["total_loss_test"], "--", label="test")
+        ax.set_title("total loss")
+        ax.set_xlabel("Epochs")
+        ax.set_yscale("log")
+        ax.legend()
+        for iext in range(1, ncol):
+            axs[0][iext].axis("off")
+        for ivar in range(num_tasks):
+            ax = axs[1][ivar]
+            ax.plot(task_train[:, ivar], label="train")
+            ax.plot(task_val[:, ivar], label="validation")
+            ax.plot(task_test[:, ivar], "--", label="test")
+            name = task_names[ivar] if task_names else f"task {ivar}"
+            if task_weights is not None:
+                name += ", {:.4f}".format(task_weights[ivar])
+            ax.set_title(name)
+            ax.set_xlabel("Epochs")
+            ax.set_yscale("log")
+            if ivar == 0:
+                ax.legend()
         fig.tight_layout()
-        fig.savefig(os.path.join(self.model_with_config_name, "history_loss.png"))
+        fig.savefig(os.path.join(self.output_dir, "history_loss.png"))
         plt.close(fig)
 
-    # ----------------------------------------------------------- parity plots
-    def create_parity_plots(
-        self, true_values: List[np.ndarray], predicted_values: List[np.ndarray]
-    ) -> None:
-        """Per-head predicted-vs-true scatter (scalar plots,
-        visualizer.py:280-383)."""
-        for ihead, (tv, pv) in enumerate(zip(true_values, predicted_values)):
-            tv = np.asarray(tv).reshape(-1)
-            pv = np.asarray(pv).reshape(-1)
-            fig, ax = plt.subplots(figsize=(5, 5))
-            ax.scatter(tv, pv, s=6, alpha=0.5, edgecolors="none")
-            lo = min(tv.min(), pv.min()) if tv.size else 0.0
-            hi = max(tv.max(), pv.max()) if tv.size else 1.0
-            ax.plot([lo, hi], [lo, hi], "r--", linewidth=1)
-            ax.set_xlabel("true")
-            ax.set_ylabel("predicted")
-            ax.set_title(f"head {ihead}")
-            fig.tight_layout()
-            fig.savefig(
-                os.path.join(
-                    self.model_with_config_name, f"parity_head{ihead}.png"
-                )
-            )
-            plt.close(fig)
-
-    create_scatter_plots = create_parity_plots
-
-    # ------------------------------------------------------- error histograms
-    def create_error_histograms(
-        self, true_values: List[np.ndarray], predicted_values: List[np.ndarray]
-    ) -> None:
-        """Per-head histogram of (pred − true) (visualizer.py:384-463)."""
-        for ihead, (tv, pv) in enumerate(zip(true_values, predicted_values)):
-            err = (np.asarray(pv) - np.asarray(tv)).reshape(-1)
-            fig, ax = plt.subplots(figsize=(5, 4))
-            ax.hist(err, bins=50)
-            ax.set_xlabel("error (pred - true)")
-            ax.set_ylabel("count")
-            ax.set_title(f"head {ihead}")
-            fig.tight_layout()
-            fig.savefig(
-                os.path.join(
-                    self.model_with_config_name, f"error_hist_head{ihead}.png"
-                )
-            )
-            plt.close(fig)
-
     # -------------------------------------------------------------- num nodes
-    def num_nodes_plot(self, nodes_num_list: Sequence[int]) -> None:
-        """Histogram of graph sizes in the test set (visualizer.py:727-735)."""
-        fig, ax = plt.subplots(figsize=(5, 4))
-        ax.hist(np.asarray(nodes_num_list), bins=30)
-        ax.set_xlabel("num nodes")
-        ax.set_ylabel("count")
-        fig.tight_layout()
-        fig.savefig(os.path.join(self.model_with_config_name, "num_nodes.png"))
+    def num_nodes_plot(self, nodes_num_list: Optional[Sequence[int]] = None):
+        """Histogram of test-set graph sizes (reference visualizer.py:727-735)."""
+        sizes = np.asarray(
+            nodes_num_list if nodes_num_list is not None else self.num_nodes_list
+        )
+        fig, ax = plt.subplots(figsize=(8, 8))
+        ax.hist(sizes)
+        ax.set_title("Histogram of graph size in test set")
+        ax.set_xlabel("number of nodes")
+        fig.savefig(os.path.join(self.output_dir, "num_nodes.png"))
         plt.close(fig)

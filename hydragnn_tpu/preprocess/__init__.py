@@ -2,6 +2,7 @@ from .dataloader import GraphDataLoader
 from .dataset_descriptors import AtomFeatures, StructureFeatures
 from .graph_build import (
     add_edge_lengths,
+    check_data_samples_equivalence,
     check_if_graph_size_variable,
     compute_edges,
     get_radius_graph_config,

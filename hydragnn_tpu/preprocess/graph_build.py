@@ -206,3 +206,33 @@ def check_if_graph_size_variable(*datasets) -> bool:
             if len(sizes) > 1:
                 return True
     return False
+
+
+def check_data_samples_equivalence(sample1: GraphSample, sample2: GraphSample,
+                                   tol: float) -> bool:
+    """Same shapes and the same edge set (edges may be listed in any order),
+    with edge attributes matching within ``tol`` (reference
+    /root/reference/hydragnn/preprocess/utils.py:32-48 — its O(E²) python loop
+    replaced by a lexicographic sort of both edge lists)."""
+    if (
+        np.shape(sample1.x) != np.shape(sample2.x)
+        or np.shape(sample1.pos) != np.shape(sample2.pos)
+        or np.shape(sample1.y) != np.shape(sample2.y)
+    ):
+        return False
+    e1 = np.asarray(sample1.edge_index)
+    e2 = np.asarray(sample2.edge_index)
+    if e1.shape != e2.shape:
+        return False
+    o1 = np.lexsort((e1[1], e1[0]))
+    o2 = np.lexsort((e2[1], e2[0]))
+    if not np.array_equal(e1[:, o1], e2[:, o2]):
+        return False
+    if (sample1.edge_attr is None) != (sample2.edge_attr is None):
+        return False
+    if sample1.edge_attr is not None:
+        a1 = np.asarray(sample1.edge_attr)[o1]
+        a2 = np.asarray(sample2.edge_attr)[o2]
+        if not np.all(np.linalg.norm(a1 - a2, axis=-1) < tol):
+            return False
+    return True

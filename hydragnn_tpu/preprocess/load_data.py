@@ -37,10 +37,15 @@ def dataset_loading_and_splitting(config: Dict):
 def create_dataloaders(trainset, valset, testset, batch_size):
     """Three GraphDataLoaders; multi-process runs shard every split by process
     (the DistributedSampler analog). Returns (train, val, test, sampler_list) for
-    reference API parity — the loaders are their own samplers here."""
+    reference API parity — the loaders are their own samplers here.
+
+    Documented divergence: the reference shuffles val/test too
+    (load_data.py:75-84), which silently misaligns its Visualizer's
+    dataset-order node features with eval-order predictions. Eval loaders
+    here keep dataset order — shuffling eval batches has no training effect."""
     world_size, rank = get_comm_size_and_rank()
     loaders = []
-    for ds, shuffle in ((trainset, True), (valset, True), (testset, True)):
+    for ds, shuffle in ((trainset, True), (valset, False), (testset, False)):
         loaders.append(
             GraphDataLoader(
                 ds,

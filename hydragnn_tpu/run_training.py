@@ -10,6 +10,8 @@ import json
 import os
 from functools import singledispatch
 
+import numpy as np
+
 from .models.create import create_model_config, init_model_variables
 from .parallel.distributed import barrier, setup_ddp
 from .preprocess.load_data import dataset_loading_and_splitting
@@ -102,6 +104,29 @@ def _(config: dict, mesh=None):
     driver = TrainingDriver(
         model, optimizer, state, mesh=mesh, verbosity=verbosity
     )
+
+    # Visualizer gets the test set's input node features and graph sizes
+    # (reference train_validate_test.py:62-76).
+    viz = None
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    output_names = voi.get("output_names")
+    if config["Visualization"].get("create_plots"):
+        from .postprocess.visualizer import Visualizer
+
+        node_feature = []
+        nodes_num_list = []
+        for sample in getattr(test_loader, "dataset", []):
+            node_feature.extend(np.asarray(sample.x)[:, 0].tolist())
+            nodes_num_list.append(int(np.asarray(sample.x).shape[0]))
+        viz = Visualizer(
+            "./logs/" + log_name,
+            node_feature=node_feature,
+            num_nodes_list=nodes_num_list,
+            num_heads=len(model.output_dim),
+            head_dims=list(model.output_dim),
+            head_types=list(model.output_type),
+        )
+
     history = train_validate_test(
         driver,
         train_loader,
@@ -112,22 +137,35 @@ def _(config: dict, mesh=None):
         scheduler=scheduler,
         profiler=profiler,
         verbosity=verbosity,
+        visualizer=viz,
+        output_names=output_names,
+        plot_init_solution=config["Visualization"].get("plot_init_solution", True),
+        plot_hist_solution=config["Visualization"].get("plot_hist_solution", False),
+        checkpoint_name=log_name,
+        checkpoint_every=config["NeuralNetwork"]["Training"].get(
+            "periodic_checkpoint_every", 0
+        ),
     )
 
-    if config["Visualization"].get("create_plots"):
-        from .postprocess.visualizer import Visualizer
-
+    if viz is not None:
+        # Final test pass for the latest predictions; denormalize first when
+        # requested (reference train_validate_test.py:141-163).
         _, _, true_values, predicted_values = driver.evaluate(
             test_loader, return_values=True
         )
-        viz = Visualizer(
-            "./logs/" + log_name,
-            node_feature=[],
-            num_heads=len(model.output_dim),
-            head_dims=list(model.output_dim),
+        if voi.get("denormalize_output") and "y_minmax" in voi:
+            from .postprocess.postprocess import output_denormalize
+
+            true_values, predicted_values = output_denormalize(
+                voi["y_minmax"], true_values, predicted_values
+            )
+        viz.create_plot_global(true_values, predicted_values, output_names)
+        viz.create_scatter_plots(true_values, predicted_values, output_names)
+        viz.plot_history(
+            history,
+            task_weights=list(model.task_weights),
+            task_names=output_names,
         )
-        viz.plot_history(history)
-        viz.create_parity_plots(true_values, predicted_values)
 
     save_model(
         {"params": driver.state.params, "batch_stats": driver.state.batch_stats},

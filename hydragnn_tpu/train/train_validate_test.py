@@ -24,9 +24,11 @@ from .trainer import (
     TrainState,
     make_eval_step,
     make_eval_step_dp,
+    make_train_epoch_scan,
     make_train_step,
     make_train_step_dp,
     stack_batches,
+    state_donation_safe,
 )
 
 
@@ -78,11 +80,18 @@ class TrainingDriver:
                 mesh.local_mesh.shape["data"] if self.multihost
                 else mesh.shape["data"]
             )
-            self.train_step = make_train_step_dp(model, optimizer, mesh)
+            donate = state_donation_safe(state)
+            self.train_step = make_train_step_dp(model, optimizer, mesh, donate)
             self.eval_step = make_eval_step_dp(model, mesh)
         else:
-            self.train_step = make_train_step(model, optimizer)
+            donate = state_donation_safe(state)
+            self.train_step = make_train_step(model, optimizer, donate)
             self.eval_step = make_eval_step(model)
+            self.epoch_scan = make_train_epoch_scan(model, optimizer, donate)
+        # Chunked lax.scan over the epoch: one device dispatch per chunk
+        # instead of per batch (dispatch overhead dominates at HydraGNN's
+        # model sizes). Chunk bounds the stacked batches' HBM footprint.
+        self.scan_chunk = 64
         self.rng = jax.random.PRNGKey(0)
 
     # ------------------------------------------------------------------ train
@@ -111,6 +120,9 @@ class TrainingDriver:
         )
 
     def train_epoch(self, loader, profiler: Optional[Profiler] = None):
+        # Scan path only when nothing needs per-step host hooks.
+        if self.mesh is None and not (profiler and profiler.active):
+            return self._train_epoch_scan(loader)
         metrics = EpochMetrics()
         batches = (
             self._device_groups(loader) if self.mesh is not None else iter(loader)
@@ -121,6 +133,30 @@ class TrainingDriver:
             if profiler:
                 profiler.step()
         return metrics.averages()
+
+    def _train_epoch_scan(self, loader):
+        """Whole-epoch lax.scan in fixed-size chunks. Chunk sizes repeat
+        across epochs (loader length is constant), so at most two compiles:
+        the full chunk and the remainder. The tqdm bar (verbosity 2/4) ticks
+        per batch as batches are consumed into chunks."""
+        metrics = EpochMetrics()
+        buf = []
+        for b in iterate_tqdm(loader, self.verbosity):
+            buf.append(b)
+            if len(buf) == self.scan_chunk:
+                self._run_scan_chunk(buf, metrics)
+                buf = []
+        if buf:
+            self._run_scan_chunk(buf, metrics)
+        return metrics.averages()
+
+    def _run_scan_chunk(self, batches, metrics):
+        if len(batches) == 1:
+            self.state, m = self.train_step(self.state, batches[0], self.rng)
+        else:
+            stacked = stack_batches(batches, len(batches))
+            self.state, m = self.epoch_scan(self.state, stacked, self.rng)
+        metrics.update(m)
 
     # ------------------------------------------------------------------- eval
     def evaluate(self, loader, return_values: bool = False):
@@ -182,9 +218,23 @@ def train_validate_test(
     scheduler: Optional[ReduceLROnPlateau] = None,
     profiler: Optional[Profiler] = None,
     verbosity: int = 0,
+    visualizer=None,
+    output_names: Optional[List[str]] = None,
+    plot_init_solution: bool = True,
+    plot_hist_solution: bool = False,
+    checkpoint_name: Optional[str] = None,
+    checkpoint_every: int = 0,
 ):
     """The epoch loop (train_validate_test.py:94-137). Returns the loss history
-    dict consumed by the Visualizer."""
+    dict consumed by the Visualizer. With a visualizer attached, mirrors the
+    reference's plot hooks: graph-size histogram + initial-solution scatter
+    before training (train_validate_test.py:68-85), optional per-epoch scatter
+    (plot_hist_solution, :131-137)."""
+    if visualizer is not None:
+        visualizer.num_nodes_plot()
+        if plot_init_solution:
+            _, _, tv, pv = driver.evaluate(test_loader, return_values=True)
+            visualizer.create_scatter_plots(tv, pv, output_names=output_names)
     history = {
         "total_loss_train": [],
         "total_loss_val": [],
@@ -235,6 +285,31 @@ def train_validate_test(
         history["task_loss_train"].append(train_rmses)
         history["task_loss_val"].append(val_rmses)
         history["task_loss_test"].append(test_rmses)
+
+        if visualizer is not None and plot_hist_solution:
+            _, _, tv, pv = driver.evaluate(test_loader, return_values=True)
+            visualizer.create_scatter_plots(
+                tv, pv, output_names=output_names, iepoch=epoch
+            )
+
+        # Mid-training periodic checkpoint — an improvement over the
+        # reference, which saves only once at the very end (SURVEY.md §5.4);
+        # a preempted multi-hour run can warm-start from the last save.
+        if (
+            checkpoint_name
+            and checkpoint_every > 0
+            and (epoch + 1) % checkpoint_every == 0
+        ):
+            from ..utils.model import save_model
+
+            save_model(
+                {
+                    "params": driver.state.params,
+                    "batch_stats": driver.state.batch_stats,
+                },
+                driver.state.opt_state,
+                checkpoint_name,
+            )
     if profiler:
         profiler.stop()
     timer.stop()

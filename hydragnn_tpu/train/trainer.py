@@ -40,10 +40,14 @@ class TrainState:
 
 
 def create_train_state(model, variables, optimizer) -> TrainState:
+    # init() on a COPY of params: optimizers that store the params pytree in
+    # their state (optax.lbfgs memory) would otherwise alias params buffers,
+    # and the donating train steps may not donate the same buffer twice.
+    params_copy = jax.tree_util.tree_map(jnp.array, variables["params"])
     return TrainState(
         params=variables["params"],
         batch_stats=variables.get("batch_stats", {}),
-        opt_state=optimizer.init(variables["params"]),
+        opt_state=optimizer.init(params_copy),
         step=jnp.zeros((), jnp.int32),
     )
 
@@ -62,9 +66,24 @@ def _loss_and_metrics(model: HydraGNN, params, batch_stats, batch, dropout_key):
     return loss, (mut["batch_stats"], rmses)
 
 
-def make_train_step(model: HydraGNN, optimizer) -> Callable:
-    @jax.jit
-    def step(state: TrainState, batch: GraphBatch, rng):
+def state_donation_safe(state: TrainState) -> bool:
+    """Donation requires every buffer in the state to appear exactly once;
+    optimizers that store the params pytree inside their own state (optax
+    lbfgs memory) repeat buffers and must run without donation."""
+    seen = set()
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array):
+            if id(leaf) in seen:
+                return False
+            seen.add(id(leaf))
+    return True
+
+
+def _step_body(model: HydraGNN, optimizer):
+    """The single-device gradient step shared by make_train_step and the
+    scanned epoch (one definition — the two compiled paths must never drift)."""
+
+    def body(state: TrainState, batch: GraphBatch, rng):
         dropout_key = jax.random.fold_in(rng, state.step)
         grad_fn = jax.value_and_grad(
             lambda p: _loss_and_metrics(model, p, state.batch_stats, batch, dropout_key),
@@ -84,7 +103,15 @@ def make_train_step(model: HydraGNN, optimizer) -> Callable:
         count = batch.count_real_graphs().astype(jnp.float32)
         return new_state, {"loss": loss * count, "rmses": rmses * count, "count": count}
 
-    return step
+    return body
+
+
+def make_train_step(model: HydraGNN, optimizer, donate: bool = True) -> Callable:
+    # donate_argnums: params/opt_state buffers are reused in place, halving
+    # HBM traffic for the state update (callers must drop the old state).
+    return jax.jit(
+        _step_body(model, optimizer), donate_argnums=(0,) if donate else ()
+    )
 
 
 def make_eval_step(model: HydraGNN) -> Callable:
@@ -105,6 +132,29 @@ def make_eval_step(model: HydraGNN) -> Callable:
         )
 
     return step
+
+
+def make_train_epoch_scan(
+    model: HydraGNN, optimizer, donate: bool = True
+) -> Callable:
+    """Whole-epoch driver: one compiled call scans the train step over a
+    stacked batch array [S, ...] (single dispatch per epoch instead of per
+    step — the python-loop dispatch overhead dominates at HydraGNN's model
+    sizes, hidden_dim 5-50 in every shipped config). Metrics come back summed
+    over steps, matching EpochMetrics' weighted accumulation."""
+
+    body = _step_body(model, optimizer)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def epoch(state: TrainState, batches: GraphBatch, rng):
+        state, metrics = jax.lax.scan(
+            lambda s, b: body(s, b, rng), state, batches
+        )
+        return state, jax.tree_util.tree_map(
+            lambda m: jnp.sum(m, axis=0), metrics
+        )
+
+    return epoch
 
 
 # ------------------------------------------------------------- DP × graph-par
@@ -128,7 +178,9 @@ def _batch_pspec(batch: GraphBatch, graph_sharded: bool) -> GraphBatch:
     )
 
 
-def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
+def make_train_step_dp(
+    model: HydraGNN, optimizer, mesh, donate: bool = True
+) -> Callable:
     """SPMD step over a ('data', 'graph') mesh. ``batch`` arrays carry a leading
     device axis [D, ...] dealt over 'data'; when the model was built with
     graph_axis='graph' and the mesh has a nontrivial 'graph' axis, edges are
@@ -193,7 +245,7 @@ def make_train_step_dp(model: HydraGNN, optimizer, mesh) -> Callable:
         )
         return sharded(state, batch, rng)
 
-    return jax.jit(step)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step_dp(model: HydraGNN, mesh) -> Callable:

@@ -44,8 +44,12 @@ def save_model(
         else None,
     }
     os.makedirs(os.path.dirname(path_name), exist_ok=True)
-    with open(path_name, "wb") as f:
+    # Atomic write: a crash mid-dump must not leave a torn checkpoint that a
+    # later warm start would fail on.
+    tmp_name = path_name + ".tmp"
+    with open(tmp_name, "wb") as f:
         pickle.dump(payload, f)
+    os.replace(tmp_name, path_name)
 
 
 def load_existing_model(

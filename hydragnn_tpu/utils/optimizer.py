@@ -21,11 +21,17 @@ def _base_optimizer(name: str, learning_rate: float):
         "adadelta": lambda lr: optax.adadelta(lr),
         "adagrad": lambda lr: optax.adagrad(lr),
         "adamax": lambda lr: optax.adamax(lr),
-        "adamw": lambda lr: optax.adamw(lr),
+        # torch AdamW's default weight_decay is 0.01 (vs optax's 1e-4); the
+        # reference relies on the torch default (optimizer.py:14).
+        "adamw": lambda lr: optax.adamw(lr, weight_decay=0.01),
         "rmsprop": lambda lr: optax.rmsprop(lr),
         # torch SparseAdam is Adam with sparse-gradient support; dense here.
         "sparseadam": lambda lr: optax.adam(lr),
-        "lbfgs": lambda lr: optax.lbfgs(lr),
+        # linesearch=None: the zoom linesearch needs (value, grad, value_fn)
+        # threaded through update(), which the generic train step doesn't do;
+        # plain limited-memory direction scaled by lr instead. The reference
+        # never ships an LBFGS config (all use AdamW).
+        "lbfgs": lambda lr: optax.lbfgs(lr, linesearch=None),
     }
     if name_l not in table:
         raise ValueError(f"Purpose of {name} optimizer is not defined.")
@@ -37,6 +43,7 @@ def select_optimizer(
     learning_rate: float,
     freeze_conv: bool = False,
 ) -> optax.GradientTransformation:
+    _base_optimizer(name, learning_rate)  # eager name validation
     opt = optax.inject_hyperparams(
         lambda learning_rate: _base_optimizer(name, learning_rate)
     )(learning_rate=learning_rate)

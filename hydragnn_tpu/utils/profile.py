@@ -38,6 +38,12 @@ class Profiler:
         elif self._active and epoch != self.target_epoch:
             self.stop()
 
+    @property
+    def active(self) -> bool:
+        """True while a trace window is open (drives the per-step train path —
+        scanned epochs would hide step boundaries from the trace)."""
+        return self._active
+
     def step(self) -> None:
         """Per-batch hook kept for API parity (jax traces need no step marker)."""
 

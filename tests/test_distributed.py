@@ -125,7 +125,9 @@ def pytest_dp_training_runs_and_reduces():
     assert losses[-1] < losses[0]
     assert float(m["count"]) == 40.0  # all real graphs counted exactly once
 
-    # Partial group: only 3 of 8 device slots have real data.
+    # Partial group: only 3 of 8 device slots have real data. NB: the train
+    # step DONATES its input state — the old state object is consumed, all
+    # later use must go through the returned state.
     partial = stack_batches(per_dev[:3], 8)
     state2, m2 = step(state, partial, rng)
     assert all(
@@ -135,6 +137,6 @@ def pytest_dp_training_runs_and_reduces():
 
     # Eval step reduces across devices too.
     eval_step = make_eval_step_dp(model, mesh)
-    em, outputs = eval_step(state, batch)
+    em, outputs = eval_step(state2, batch)
     assert float(em["count"]) == 40.0
     assert outputs[0].shape[0] == 8  # leading device axis restored

@@ -11,6 +11,7 @@ import numpy as np
 from hydragnn_tpu.graphs.sample import GraphSample
 from hydragnn_tpu.preprocess.graph_build import (
     add_edge_lengths,
+    check_data_samples_equivalence,
     compute_edges,
     normalize_rotation,
 )
@@ -59,6 +60,7 @@ def unittest_rotational_invariance(pos, tol):
         assert set(e_base) == set(e_rot), "edge sets differ under rotation"
         for k in e_base:
             assert abs(e_base[k] - e_rot[k]) < tol, (k, e_base[k], e_rot[k])
+        assert check_data_samples_equivalence(base, rotated, tol)
 
 
 def pytest_rotational_invariance_bct():
