@@ -86,6 +86,10 @@ class HydraGNN(nn.Module):
     ilossweights_nll: int = 0
     # Mesh axis name for edge-sharded graph parallelism (None = off).
     graph_axis: Optional[str] = None
+    # Mixed precision: 'bfloat16' runs the network in bf16 on the MXU with
+    # float32 master weights, loss, and BatchNorm statistics (trainer casts;
+    # None = full float32). Not a reference feature — TPU-native addition.
+    compute_dtype: Optional[str] = None
     # Conv-family-specific static parameters.
     edge_dim: Optional[int] = None
     pna_deg_avg_log: float = 1.0

@@ -37,6 +37,7 @@ def create_model_config(
         max_neighbours=config.get("max_neighbours"),
         edge_dim=config.get("edge_dim"),
         pna_deg=config.get("pna_deg"),
+        compute_dtype=config.get("compute_dtype"),
         verbosity=verbosity,
     )
 
@@ -56,6 +57,7 @@ def create_model(
     max_neighbours: Optional[int] = None,
     edge_dim: Optional[int] = None,
     pna_deg: Optional[Sequence[float]] = None,
+    compute_dtype: Optional[str] = None,
     verbosity: int = 0,
 ) -> HydraGNN:
     if len(task_weights) != len(output_dim):
@@ -90,6 +92,7 @@ def create_model(
         num_nodes=num_nodes,
         initial_bias=initial_bias,
         edge_dim=edge_dim,
+        compute_dtype=compute_dtype,
         **kwargs,
     )
 

@@ -33,6 +33,10 @@ class MaskedBatchNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (self.features,))
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
 
+        in_dtype = x.dtype
+        # Statistics always in float32 — bf16 mixed-precision compute must not
+        # degrade the running mean/var (sums over many rows lose bits in bf16).
+        x = x.astype(jnp.float32)
         if train:
             mean = masked_mean(x, mask, axis=0)
             mean_sq = masked_mean(jnp.square(x), mask, axis=0)
@@ -45,7 +49,7 @@ class MaskedBatchNorm(nn.Module):
 
         y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps)) * scale + bias
         # Keep padding rows at zero so downstream masked statistics stay exact.
-        return jnp.where(mask[:, None], y, 0.0)
+        return jnp.where(mask[:, None], y, 0.0).astype(in_dtype)
 
 
 class MLP(nn.Module):

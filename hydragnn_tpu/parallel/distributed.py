@@ -31,6 +31,18 @@ def init_comm_size_and_rank() -> Tuple[int, int]:
     return world_size, world_rank
 
 
+def _distributed_active() -> bool:
+    """Whether jax.distributed.initialize already ran — checked WITHOUT
+    touching jax.process_count(), which would initialize the XLA backend and
+    make a later initialize() impossible."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def setup_ddp(coordinator_address: Optional[str] = None) -> Tuple[int, int]:
     """Process-group bootstrap (reference setup_ddp, distributed.py:110-158).
 
@@ -39,7 +51,7 @@ def setup_ddp(coordinator_address: Optional[str] = None) -> Tuple[int, int]:
     reference's try/except (distributed.py:134-157).
     """
     world_size, world_rank = init_comm_size_and_rank()
-    if world_size > 1 and jax.process_count() == 1:
+    if world_size > 1 and not _distributed_active():
         try:
             if coordinator_address is None:
                 master_addr = os.getenv("MASTER_ADDR", "127.0.0.1")

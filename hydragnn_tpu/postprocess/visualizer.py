@@ -68,8 +68,12 @@ class Visualizer:
         return self.output_dir
 
     def _path(self, stem: str, iepoch=None) -> str:
-        if iepoch is not None and iepoch >= 0:
-            stem = f"{stem}_{str(iepoch).zfill(4)}"
+        if iepoch is not None:
+            # Negative epoch = pre-training "initial solution" plots (the
+            # reference passes iepoch=-1, train_validate_test.py:84); they must
+            # not share a filename with the end-of-run plots (iepoch=None).
+            suffix = "init" if iepoch < 0 else str(iepoch).zfill(4)
+            stem = f"{stem}_{suffix}"
         return os.path.join(self.output_dir, stem + ".png")
 
     def _fixed_graph_size(self) -> Optional[int]:
@@ -357,8 +361,12 @@ class Visualizer:
         for ivar in range(num_tasks):
             ax = axs[1][ivar]
             ax.plot(task_train[:, ivar], label="train")
-            ax.plot(task_val[:, ivar], label="validation")
-            ax.plot(task_test[:, ivar], "--", label="test")
+            # Empty val/test splits yield (epochs, 0) task arrays — skip those
+            # series instead of indexing out of range.
+            if task_val.shape[1] > ivar:
+                ax.plot(task_val[:, ivar], label="validation")
+            if task_test.shape[1] > ivar:
+                ax.plot(task_test[:, ivar], "--", label="test")
             name = task_names[ivar] if task_names else f"task {ivar}"
             if task_weights is not None:
                 name += ", {:.4f}".format(task_weights[ivar])

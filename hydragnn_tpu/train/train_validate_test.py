@@ -234,7 +234,9 @@ def train_validate_test(
         visualizer.num_nodes_plot()
         if plot_init_solution:
             _, _, tv, pv = driver.evaluate(test_loader, return_values=True)
-            visualizer.create_scatter_plots(tv, pv, output_names=output_names)
+            visualizer.create_scatter_plots(
+                tv, pv, output_names=output_names, iepoch=-1
+            )
     history = {
         "total_loss_train": [],
         "total_loss_val": [],

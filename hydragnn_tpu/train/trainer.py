@@ -52,9 +52,42 @@ def create_train_state(model, variables, optimizer) -> TrainState:
     )
 
 
+def _cast_floats(tree, dtype):
+    """Cast floating leaves to dtype (ints/masks untouched)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def _apply_model(model: HydraGNN, params, batch_stats, batch, **kwargs):
+    """model.apply with the model's mixed-precision policy: bf16 compute
+    (params + input features cast inside the differentiated function, so
+    gradients accumulate in the float32 master params), float32 outputs."""
+    cd = model.compute_dtype
+    if cd:
+        params = _cast_floats(params, jnp.dtype(cd))
+        batch = batch.replace(
+            node_features=batch.node_features.astype(jnp.dtype(cd)),
+            edge_features=None
+            if batch.edge_features is None
+            else batch.edge_features.astype(jnp.dtype(cd)),
+        )
+    out = model.apply({"params": params, "batch_stats": batch_stats}, batch, **kwargs)
+    if cd:
+        if isinstance(out, tuple):  # (outputs, mutated)
+            return [o.astype(jnp.float32) for o in out[0]], *out[1:]
+        return [o.astype(jnp.float32) for o in out]
+    return out
+
+
 def _loss_and_metrics(model: HydraGNN, params, batch_stats, batch, dropout_key):
-    outputs, mut = model.apply(
-        {"params": params, "batch_stats": batch_stats},
+    outputs, mut = _apply_model(
+        model,
+        params,
+        batch_stats,
         batch,
         train=True,
         mutable=["batch_stats"],
@@ -117,10 +150,8 @@ def make_train_step(model: HydraGNN, optimizer, donate: bool = True) -> Callable
 def make_eval_step(model: HydraGNN) -> Callable:
     @jax.jit
     def step(state: TrainState, batch: GraphBatch):
-        outputs = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            batch,
-            train=False,
+        outputs = _apply_model(
+            model, state.params, state.batch_stats, batch, train=False
         )
         loss, rmses = multihead_rmse_loss(
             outputs, batch, model.output_type, model.task_weights
@@ -255,10 +286,8 @@ def make_eval_step_dp(model: HydraGNN, mesh) -> Callable:
 
     def _local(state, batch):
         batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        outputs = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            batch,
-            train=False,
+        outputs = _apply_model(
+            model, state.params, state.batch_stats, batch, train=False
         )
         loss, rmses = multihead_rmse_loss(
             outputs, batch, model.output_type, model.task_weights

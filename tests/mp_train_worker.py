@@ -1,0 +1,39 @@
+"""Worker for the 2-process distributed test (the reference CI's
+``mpirun -n 2 python -m pytest --with-mpi`` analog, /root/reference/.github/
+workflows/CI.yml:47-52). Launched by tests/test_multiprocess.py with
+OMPI_COMM_WORLD_* env set; rendezvouses via jax.distributed over TCP, builds a
+global 2-device CPU mesh (1 local device per process), and runs the full
+high-level run_training on it."""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["HYDRAGNN_REPO"])
+
+from hydragnn_tpu.parallel.distributed import make_mesh, setup_ddp  # noqa: E402
+
+
+def main():
+    config_path = sys.argv[1]
+    world_size, rank = setup_ddp()
+    assert world_size == 2, f"expected 2 processes, got {world_size}"
+    # Each process contributes its local devices (8 virtual CPU devices when
+    # launched under the test conftest's XLA_FLAGS) to the global mesh.
+    assert jax.device_count() == 2 * len(jax.local_devices())
+
+    import hydragnn_tpu  # noqa: E402
+
+    with open(config_path) as f:
+        config = json.load(f)
+    mesh = make_mesh()  # 2 global devices -> data_axis=2
+    history = hydragnn_tpu.run_training(config, mesh=mesh)
+    print(f"FINAL_LOSS {history['total_loss_train'][-1]:.10f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Mixed-precision (bf16 compute / f32 master weights) — a TPU-native addition
+with no reference analog: the network runs in bfloat16 on the MXU while
+parameters, gradients, loss, and BatchNorm statistics stay float32
+(hydragnn_tpu/train/trainer.py _apply_model; layers.py MaskedBatchNorm)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.graphs import GraphSample, collate_graphs
+from hydragnn_tpu.models import create_model, init_model_variables
+from hydragnn_tpu.train.trainer import create_train_state, make_train_step
+from hydragnn_tpu.utils.optimizer import select_optimizer
+
+HEADS = {
+    "graph": {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 8,
+        "num_headlayers": 2,
+        "dim_headlayers": [8, 8],
+    },
+    "node": {"num_headlayers": 2, "dim_headlayers": [8, 8], "type": "mlp"},
+}
+
+
+def _graphs(rng, count=16):
+    out = []
+    for _ in range(count):
+        n = int(rng.integers(4, 9))
+        x = rng.normal(size=(n, 1)).astype(np.float32)
+        ei = np.stack([np.arange(n), (np.arange(n) + 1) % n]).astype(np.int32)
+        ei = np.concatenate([ei, ei[::-1]], axis=1)
+        y = np.concatenate([[x.sum()], x[:, 0]]).astype(np.float32)
+        y_loc = np.array([[0, 1, 1 + n]], dtype=np.int64)
+        out.append(
+            GraphSample(x=x, pos=np.zeros((n, 3), np.float32), y=y, y_loc=y_loc,
+                        edge_index=ei)
+        )
+    return out
+
+
+def _train(compute_dtype, steps=30):
+    rng = np.random.default_rng(0)
+    batch = collate_graphs(_graphs(rng), ("graph", "node"), (1, 1))
+    model = create_model(
+        "SAGE", 1, 16, (1, 1), ("graph", "node"), HEADS, [1.0, 1.0], 2,
+        compute_dtype=compute_dtype,
+    )
+    variables = init_model_variables(model, batch)
+    opt = select_optimizer("Adam", 5e-3)
+    state = create_train_state(model, variables, opt)
+    step = make_train_step(model, opt)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, batch, key)
+        losses.append(float(m["loss"]) / float(m["count"]))
+    return state, losses
+
+
+def pytest_bf16_params_stay_f32_and_converge():
+    state, losses = _train("bfloat16")
+    # master weights, opt state, and BN stats all stay f32
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(state.batch_stats):
+        assert leaf.dtype == jnp.float32
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def pytest_bf16_tracks_f32_training():
+    _, l32 = _train(None)
+    _, l16 = _train("bfloat16")
+    # same trajectory within bf16 resolution (~1e-2 relative)
+    assert abs(l16[-1] - l32[-1]) < 0.1 * max(l32[0], 1e-6), (l32[-1], l16[-1])
