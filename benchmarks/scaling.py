@@ -1,0 +1,113 @@
+"""Weak-scaling study of the data-parallel train step — the DDP-scaling-
+efficiency analog in BASELINE.json's north-star metric set. Fixed per-device
+batch; the mesh 'data' axis grows 1 → N; ideal scaling keeps graphs/sec/device
+constant.
+
+Runs on whatever devices exist: a real TPU slice, or a virtual CPU mesh:
+
+    python benchmarks/scaling.py            # all visible devices
+    python benchmarks/scaling.py --devices 8 --cpu
+
+Prints one JSON line per mesh size:
+  {"devices": D, "graphs_per_sec": X, "efficiency": X / (D * X_1dev)}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import os
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PER_DEV_BATCH = 64
+STEPS = 20
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0, help="max devices (0=all)")
+    ap.add_argument("--cpu", action="store_true", help="force a virtual CPU mesh")
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.cpu:
+        n = args.devices or 8
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    from __graft_entry__ import DIMS, TYPES, _build_model, _make_graphs
+    from hydragnn_tpu.graphs import collate_graphs
+    from hydragnn_tpu.models import init_model_variables
+    from hydragnn_tpu.parallel import make_mesh
+    from hydragnn_tpu.train.trainer import (
+        create_train_state,
+        make_train_step_dp,
+        stack_batches,
+    )
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    n_avail = len(jax.devices())
+    max_dev = min(args.devices or n_avail, n_avail)
+    sizes = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= max_dev]
+
+    rng = np.random.default_rng(0)
+    base = None
+    for d in sizes:
+        mesh = make_mesh(data_axis=d, graph_axis=1)
+        per_dev = [
+            collate_graphs(
+                _make_graphs(PER_DEV_BATCH, rng, 12, 26), TYPES, DIMS,
+                num_nodes_pad=PER_DEV_BATCH * 26,
+                num_edges_pad=PER_DEV_BATCH * 26 * 20,
+                num_graphs_pad=PER_DEV_BATCH + 1,
+                edge_dim=1,
+            )
+            for _ in range(d)
+        ]
+        batch = stack_batches(per_dev, d)
+        model = _build_model(hidden=args.hidden, layers=args.layers)
+        variables = init_model_variables(model, per_dev[0])
+        opt = select_optimizer("AdamW", 1e-3)
+        state = create_train_state(model, variables, opt)
+        step = make_train_step_dp(model, opt, mesh)
+        key = jax.random.PRNGKey(0)
+
+        state, m = step(state, batch, key)  # compile
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, m = step(state, batch, key)
+        jax.block_until_ready(m["loss"])
+        el = time.perf_counter() - t0
+
+        gps = PER_DEV_BATCH * d * STEPS / el
+        if base is None:
+            base = gps
+        print(
+            json.dumps(
+                {
+                    "devices": d,
+                    "graphs_per_sec": round(gps, 1),
+                    "per_device": round(gps / d, 1),
+                    "efficiency": round(gps / (d * base), 3),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Observability + postprocess units: timers (reference time_utils.py:22-138),
+the epoch-targeted profiler window (profile.py:9-68), denormalization
+(postprocess.py:13-54), and verbosity-gated printing (print_utils.py:20-103)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.postprocess.postprocess import (
+    output_denormalize,
+    unscale_features_by_num_nodes,
+    unscale_features_by_num_nodes_config,
+)
+from hydragnn_tpu.utils.print_utils import iterate_tqdm, print_distributed
+from hydragnn_tpu.utils.profile import Profiler
+from hydragnn_tpu.utils.time_utils import Timer, reduce_timers
+
+
+def pytest_timer_accumulates_and_reduces():
+    Timer.reset()
+    t = Timer("unit_phase")
+    t.start()
+    time.sleep(0.01)
+    t.stop()
+    with Timer("unit_phase"):
+        time.sleep(0.01)
+    stats = reduce_timers()
+    assert "unit_phase" in stats
+    assert stats["unit_phase"]["min"] >= 0.02
+    assert stats["unit_phase"]["min"] == stats["unit_phase"]["max"]  # 1 process
+    Timer.reset()
+    assert reduce_timers() == {}
+
+
+def pytest_timer_misuse_raises():
+    t = Timer("misuse")
+    with pytest.raises(RuntimeError):
+        t.stop()
+    t.start()
+    with pytest.raises(RuntimeError):
+        t.start()
+    t.stop()
+
+
+def pytest_profiler_epoch_window(tmp_path):
+    prof = Profiler(str(tmp_path))
+    prof.setup({"enable": 1, "target_epoch": 1})
+    assert prof.enabled and not prof.active
+    prof.set_current_epoch(0)
+    assert not prof.active
+    prof.set_current_epoch(1)
+    assert prof.active
+    with prof.annotate("span"):
+        pass
+    prof.set_current_epoch(2)  # window closes
+    assert not prof.active
+    assert os.path.isdir(prof.trace_dir)
+    # trace files actually written
+    found = any(files for _, _, files in os.walk(prof.trace_dir))
+    assert found, "no profiler trace output"
+
+
+def pytest_profiler_disabled_noop(tmp_path):
+    prof = Profiler(str(tmp_path))
+    prof.setup(None)
+    prof.set_current_epoch(0)
+    assert not prof.active and not prof.enabled
+
+
+def pytest_output_denormalize_roundtrip():
+    rng = np.random.default_rng(0)
+    raw_t = [rng.random((10, 1)) * 7 - 3, rng.random((20, 1)) * 2]
+    raw_p = [v + 0.1 for v in raw_t]
+    y_minmax = [
+        [np.array([-3.0]), np.array([4.0])],
+        [np.array([0.0]), np.array([2.0])],
+    ]
+    norm_t = [
+        (v - mm[0]) / (mm[1] - mm[0]) for v, mm in zip(raw_t, y_minmax)
+    ]
+    norm_p = [
+        (v - mm[0]) / (mm[1] - mm[0]) for v, mm in zip(raw_p, y_minmax)
+    ]
+    got_t, got_p = output_denormalize(y_minmax, norm_t, norm_p)
+    for g, r in zip(got_t, raw_t):
+        np.testing.assert_allclose(g, r, rtol=1e-12)
+    for g, r in zip(got_p, raw_p):
+        np.testing.assert_allclose(g, r, rtol=1e-12)
+
+
+def pytest_unscale_by_num_nodes():
+    nodes = [2, 4]
+    heads = [np.array([[1.0], [1.0]]), np.array([[3.0], [5.0]])]
+    (out,) = unscale_features_by_num_nodes([heads], [1], nodes)
+    np.testing.assert_allclose(out[0], [[1.0], [1.0]])  # untouched head
+    np.testing.assert_allclose(out[1], [[6.0], [20.0]])  # scaled by node count
+
+    config = {
+        "NeuralNetwork": {
+            "Variables_of_interest": {
+                "output_names": ["energy", "mag_scaled_num_nodes"],
+                "denormalize_output": True,
+            }
+        }
+    }
+    heads2 = [np.array([[1.0], [1.0]]), np.array([[3.0], [5.0]])]
+    (out2,) = unscale_features_by_num_nodes_config(config, [heads2], nodes)
+    np.testing.assert_allclose(out2[1], [[6.0], [20.0]])
+
+
+def pytest_unscale_requires_denormalize():
+    config = {
+        "NeuralNetwork": {
+            "Variables_of_interest": {
+                "output_names": ["mag_scaled_num_nodes"],
+                "denormalize_output": False,
+            }
+        }
+    }
+    with pytest.raises(AssertionError):
+        unscale_features_by_num_nodes_config(
+            config, [[np.array([[1.0]])]], [2]
+        )
+
+
+def pytest_verbosity_gating(capsys):
+    print_distributed(0, "hidden")
+    assert capsys.readouterr().out == ""
+    print_distributed(2, "shown")
+    assert "shown" in capsys.readouterr().out
+    # iterate_tqdm passes items through at any verbosity
+    assert list(iterate_tqdm(range(3), 0)) == [0, 1, 2]
+    assert list(iterate_tqdm(range(3), 2)) == [0, 1, 2]
