@@ -90,6 +90,10 @@ class HydraGNN(nn.Module):
     # float32 master weights, loss, and BatchNorm statistics (trainer casts;
     # None = full float32). Not a reference feature — TPU-native addition.
     compute_dtype: Optional[str] = None
+    # Rematerialize conv layers in the backward pass (jax.checkpoint):
+    # activations of the encoder are recomputed instead of stored, trading
+    # FLOPs for HBM on large graphs. TPU-native addition.
+    remat: bool = False
     # Conv-family-specific static parameters.
     edge_dim: Optional[int] = None
     pna_deg_avg_log: float = 1.0
@@ -111,14 +115,19 @@ class HydraGNN(nn.Module):
     def _make_conv(self, in_dim: int, out_dim: int, name: str, concat: bool = True):
         ct = self.conv_type
         ax = self.graph_axis
+
+        def cls(c):
+            # static_argnums: `train` (last positional arg) is a python bool.
+            return nn.remat(c, static_argnums=(7,)) if self.remat else c
+
         if ct == "SAGE":
-            return SAGEConv(out_dim, axis_name=ax, name=name)
+            return cls(SAGEConv)(out_dim, axis_name=ax, name=name)
         if ct == "GIN":
-            return GINConv(out_dim, axis_name=ax, name=name)
+            return cls(GINConv)(out_dim, axis_name=ax, name=name)
         if ct == "MFC":
-            return MFCConv(out_dim, self.mfc_max_degree, axis_name=ax, name=name)
+            return cls(MFCConv)(out_dim, self.mfc_max_degree, axis_name=ax, name=name)
         if ct == "GAT":
-            return GATv2Conv(
+            return cls(GATv2Conv)(
                 out_dim,
                 heads=self.gat_heads,
                 negative_slope=self.gat_negative_slope,
@@ -128,9 +137,9 @@ class HydraGNN(nn.Module):
                 name=name,
             )
         if ct == "CGCNN":
-            return CGConv(edge_dim=self.edge_dim or 0, axis_name=ax, name=name)
+            return cls(CGConv)(edge_dim=self.edge_dim or 0, axis_name=ax, name=name)
         if ct == "PNA":
-            return PNAConv(
+            return cls(PNAConv)(
                 out_dim,
                 deg_avg_log=self.pna_deg_avg_log,
                 deg_avg_lin=self.pna_deg_avg_lin,
@@ -268,6 +277,8 @@ class HydraGNN(nn.Module):
         edge_attr = batch.edge_features if self.use_edge_attr else None
         # Reference encoder loop: x = relu(bn(conv(x))) (Base.py:236-243).
         for conv, bn in zip(self.convs, self.batch_norms):
+            # train passed positionally: nn.remat static_argnums needs it
+            # positional to keep the python-bool branch static.
             c = conv(
                 x,
                 batch.senders,
@@ -275,7 +286,7 @@ class HydraGNN(nn.Module):
                 edge_attr,
                 batch.edge_mask,
                 batch.node_mask,
-                train=train,
+                train,
             )
             x = nn.relu(bn(c, batch.node_mask, train))
 
@@ -309,7 +320,7 @@ class HydraGNN(nn.Module):
                             None,
                             batch.edge_mask,
                             batch.node_mask,
-                            train=train,
+                            train,
                         )
                         # Reference applies relu(bn(.)) through the output layer
                         # too (Base.forward, Base.py:261-265).

@@ -38,6 +38,7 @@ def create_model_config(
         edge_dim=config.get("edge_dim"),
         pna_deg=config.get("pna_deg"),
         compute_dtype=config.get("compute_dtype"),
+        remat=config.get("remat", False),
         verbosity=verbosity,
     )
 
@@ -58,6 +59,7 @@ def create_model(
     edge_dim: Optional[int] = None,
     pna_deg: Optional[Sequence[float]] = None,
     compute_dtype: Optional[str] = None,
+    remat: bool = False,
     verbosity: int = 0,
 ) -> HydraGNN:
     if len(task_weights) != len(output_dim):
@@ -93,6 +95,7 @@ def create_model(
         initial_bias=initial_bias,
         edge_dim=edge_dim,
         compute_dtype=compute_dtype,
+        remat=bool(remat),
         **kwargs,
     )
 

@@ -5,8 +5,14 @@ Replaces torch_geometric DataLoader + torch DistributedSampler (reference
 DistributedSampler semantics: indices are globally shuffled with a per-epoch seed
 (the ``sampler.set_epoch`` contract, train_validate_test.py:96-97), padded to a
 multiple of the shard count by wrapping around, then dealt round-robin so every
-shard sees the same number of batches. Pad sizes are computed once over the whole
-dataset so every shard/batch compiles to the same XLA shapes.
+shard sees the same number of batches.
+
+Recompilation control vs padding waste (SURVEY.md §7 hard part #4): with
+``num_buckets=1`` the whole dataset shares one worst-case pad shape (one XLA
+compile). Datasets mixing small and large graphs can set ``num_buckets=K``:
+samples are partitioned into K node-count quantile buckets, each with its own
+pad shape — K compiles, far less padding FLOP waste. Batches are formed within
+buckets and the batch order is shuffled across buckets per epoch.
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ class GraphDataLoader:
         head_types: Optional[Sequence[str]] = None,
         head_dims: Optional[Sequence[int]] = None,
         edge_dim: Optional[int] = None,
+        num_buckets: int = 1,
     ):
         self.dataset = list(dataset)
         self.batch_size = batch_size
@@ -43,12 +50,39 @@ class GraphDataLoader:
         self.head_dims = tuple(head_dims) if head_dims else None
         self.edge_dim = edge_dim
         self.epoch = 0
-        if self.dataset:
-            self._n_pad, self._e_pad, self._g_pad = compute_pad_sizes(
-                self.dataset, batch_size
-            )
-        else:
-            self._n_pad = self._e_pad = self._g_pad = 0
+        self._build_buckets(max(1, int(num_buckets)))
+
+    def _build_buckets(self, num_buckets: int) -> None:
+        """Partition dataset indices into node-count quantile buckets, each
+        with its own static pad shape."""
+        n = len(self.dataset)
+        if n == 0:
+            self._buckets = []
+            self._bucket_pads = []
+            return
+        sizes = np.array([s.num_nodes for s in self.dataset])
+        num_buckets = min(num_buckets, n)
+        order = np.argsort(sizes, kind="stable")
+        splits = np.array_split(order, num_buckets)
+        # Merge buckets that collapsed to identical size ranges (uniform data).
+        buckets: List[np.ndarray] = []
+        for part in splits:
+            if len(part) == 0:
+                continue
+            if buckets and sizes[part].max() == sizes[buckets[-1]].max() and (
+                sizes[part].min() == sizes[buckets[-1]].min()
+            ):
+                buckets[-1] = np.concatenate([buckets[-1], part])
+            else:
+                buckets.append(part)
+        # Keep ascending dataset order WITHIN each bucket: with shuffle=False
+        # and num_buckets=1 iteration order is exactly dataset order (the
+        # eval-loader guarantee documented in load_data.create_dataloaders).
+        self._buckets = [np.sort(b) for b in buckets]
+        self._bucket_pads = [
+            compute_pad_sizes([self.dataset[i] for i in b], self.batch_size)
+            for b in self._buckets
+        ]
 
     # -- reference parity: sampler.set_epoch reshuffles DP shards each epoch.
     def set_epoch(self, epoch: int) -> None:
@@ -63,36 +97,58 @@ class GraphDataLoader:
 
     @property
     def pad_sizes(self):
-        return self._n_pad, self._e_pad, self._g_pad
+        """Worst-case pad shape every batch fits (elementwise max over
+        buckets — the largest-node bucket need not have the most edges)."""
+        if not self._bucket_pads:
+            return (0, 0, 0)
+        return tuple(max(p[i] for p in self._bucket_pads) for i in range(3))
 
-    def _shard_indices(self) -> List[int]:
-        n = len(self.dataset)
-        idx = np.arange(n)
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
+    @property
+    def num_buckets(self) -> int:
+        return len(self._buckets)
+
+    def _shard(self, idx: np.ndarray, rng: Optional[np.random.Generator]):
+        if self.shuffle and rng is not None:
+            idx = idx.copy()
             rng.shuffle(idx)
         if self.num_shards > 1:
             # Wrap-pad so all shards get equal counts (DistributedSampler does
             # the same duplication), then deal round-robin.
-            per_shard = -(-n // self.num_shards)
+            per_shard = -(-len(idx) // self.num_shards)
             padded = np.resize(idx, per_shard * self.num_shards)
             idx = padded[self.shard_rank :: self.num_shards]
-        return idx.tolist()
+        return idx
+
+    def _batch_plan(self) -> List[tuple]:
+        """[(bucket_id, [sample indices])] for this epoch, batch order shuffled
+        across buckets."""
+        rng = (
+            np.random.default_rng(self.seed + self.epoch)
+            if self.shuffle
+            else None
+        )
+        plan = []
+        for bi, bucket in enumerate(self._buckets):
+            idx = self._shard(np.asarray(bucket), rng)
+            for start in range(0, len(idx), self.batch_size):
+                plan.append((bi, idx[start : start + self.batch_size].tolist()))
+        if rng is not None and len(self._buckets) > 1:
+            rng.shuffle(plan)
+        return plan
 
     def __len__(self) -> int:
-        n = len(self._shard_indices())
-        return -(-n // self.batch_size) if n else 0
+        return len(self._batch_plan())
 
     def __iter__(self) -> Iterator[GraphBatch]:
-        idx = self._shard_indices()
-        for start in range(0, len(idx), self.batch_size):
-            chunk = [self.dataset[i] for i in idx[start : start + self.batch_size]]
+        for bi, sample_idx in self._batch_plan():
+            n_pad, e_pad, g_pad = self._bucket_pads[bi]
+            chunk = [self.dataset[i] for i in sample_idx]
             yield collate_graphs(
                 chunk,
                 head_types=self.head_types or (),
                 head_dims=self.head_dims or (),
-                num_nodes_pad=self._n_pad,
-                num_edges_pad=self._e_pad,
-                num_graphs_pad=self._g_pad,
+                num_nodes_pad=n_pad,
+                num_edges_pad=e_pad,
+                num_graphs_pad=g_pad,
                 edge_dim=self.edge_dim,
             )

@@ -31,10 +31,11 @@ def dataset_loading_and_splitting(config: Dict):
         valset,
         testset,
         batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
+        num_buckets=config["Dataset"].get("num_buckets", 1),
     )
 
 
-def create_dataloaders(trainset, valset, testset, batch_size):
+def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1):
     """Three GraphDataLoaders; multi-process runs shard every split by process
     (the DistributedSampler analog). Returns (train, val, test, sampler_list) for
     reference API parity — the loaders are their own samplers here.
@@ -53,6 +54,7 @@ def create_dataloaders(trainset, valset, testset, batch_size):
                 shuffle=shuffle,
                 num_shards=world_size,
                 shard_rank=rank,
+                num_buckets=num_buckets,
             )
         )
     train_loader, val_loader, test_loader = loaders

@@ -95,18 +95,31 @@ class TrainingDriver:
         self.rng = jax.random.PRNGKey(0)
 
     # ------------------------------------------------------------------ train
+    @staticmethod
+    def _shape_key(batch: GraphBatch):
+        return (
+            batch.node_features.shape,
+            batch.senders.shape,
+            batch.num_graphs_pad,
+        )
+
     def _device_groups(self, loader):
         """Lazily yield per-device batch groups stacked for shard_map. Used for
         ANY mesh run (even data_axis=1 — the sharded step always expects the
-        leading device axis)."""
-        group = []
+        leading device axis). Bucketed loaders emit several static shapes;
+        groups are formed per shape (tail groups are padded with empty
+        batches by stack_batches)."""
+        groups: dict = {}
         for b in loader:
+            key = self._shape_key(b)
+            group = groups.setdefault(key, [])
             group.append(b)
             if len(group) == self.n_devices:
                 yield self._lift(stack_batches(group, self.n_devices))
-                group = []
-        if group:
-            yield self._lift(stack_batches(group, self.n_devices))
+                groups[key] = []
+        for group in groups.values():
+            if group:
+                yield self._lift(stack_batches(group, self.n_devices))
 
     def _lift(self, stacked):
         """Host-local stacked batch → global jax.Array across processes."""
@@ -135,19 +148,22 @@ class TrainingDriver:
         return metrics.averages()
 
     def _train_epoch_scan(self, loader):
-        """Whole-epoch lax.scan in fixed-size chunks. Chunk sizes repeat
-        across epochs (loader length is constant), so at most two compiles:
-        the full chunk and the remainder. The tqdm bar (verbosity 2/4) ticks
-        per batch as batches are consumed into chunks."""
+        """Whole-epoch lax.scan in fixed-size chunks, buffered per batch shape
+        (bucketed loaders emit a handful of static shapes). Chunk sizes repeat
+        across epochs (loader length is constant), so compiles stay bounded:
+        per shape, the full chunk plus remainders. The tqdm bar (verbosity
+        2/4) ticks per batch as batches are consumed into chunks."""
         metrics = EpochMetrics()
-        buf = []
+        bufs: dict = {}
         for b in iterate_tqdm(loader, self.verbosity):
+            buf = bufs.setdefault(self._shape_key(b), [])
             buf.append(b)
             if len(buf) == self.scan_chunk:
                 self._run_scan_chunk(buf, metrics)
-                buf = []
-        if buf:
-            self._run_scan_chunk(buf, metrics)
+                buf.clear()
+        for buf in bufs.values():
+            if buf:
+                self._run_scan_chunk(buf, metrics)
         return metrics.averages()
 
     def _run_scan_chunk(self, batches, metrics):
