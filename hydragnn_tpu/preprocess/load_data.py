@@ -54,7 +54,10 @@ def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1):
                 shuffle=shuffle,
                 num_shards=world_size,
                 shard_rank=rank,
-                num_buckets=num_buckets,
+                # Bucketing reorders iteration bucket-major; only the train
+                # loader may do that — eval loaders keep exact dataset order
+                # (run_prediction rows must align with the test set).
+                num_buckets=num_buckets if shuffle else 1,
             )
         )
     train_loader, val_loader, test_loader = loaders

@@ -32,6 +32,69 @@ from .trainer import (
 )
 
 
+class _Prefetcher:
+    """Background-thread batch producer: host-side collation (numpy packing in
+    GraphDataLoader.__iter__) overlaps with device compute instead of
+    serializing with it. Bounded queue; exceptions re-raised at the consumer;
+    abandoning iteration (e.g. the train step raising) cancels the producer so
+    neither the thread nor queued batches leak."""
+
+    _SENTINEL = object()
+
+    def __init__(self, iterable, depth: int = 8):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err = None
+        self._cancel = threading.Event()
+
+        def _run():
+            try:
+                for item in iterable:
+                    while not self._cancel.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._cancel.is_set():
+                        return
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                try:
+                    self._q.put_nowait(self._SENTINEL)
+                except queue.Full:
+                    pass  # consumer gone; cancel() drains
+
+        self._thread = threading.Thread(
+            target=_run, name="hydragnn-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._cancel.set()
+        # Drain so a producer blocked on put() wakes and exits.
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._SENTINEL:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            self.close()
+
+
 class EpochMetrics:
     """Graph-count-weighted averages accumulated over an epoch."""
 
@@ -115,11 +178,13 @@ class TrainingDriver:
             group = groups.setdefault(key, [])
             group.append(b)
             if len(group) == self.n_devices:
-                yield self._lift(stack_batches(group, self.n_devices))
+                # Host-side numpy only — the consumer lifts to device arrays
+                # one group at a time, so the prefetch queue never pins HBM.
+                yield stack_batches(group, self.n_devices)
                 groups[key] = []
         for group in groups.values():
             if group:
-                yield self._lift(stack_batches(group, self.n_devices))
+                yield stack_batches(group, self.n_devices)
 
     def _lift(self, stacked):
         """Host-local stacked batch → global jax.Array across processes."""
@@ -137,10 +202,12 @@ class TrainingDriver:
         if self.mesh is None and not (profiler and profiler.active):
             return self._train_epoch_scan(loader)
         metrics = EpochMetrics()
-        batches = (
+        batches = _Prefetcher(
             self._device_groups(loader) if self.mesh is not None else iter(loader)
         )
         for batch in iterate_tqdm(batches, self.verbosity):
+            if self.mesh is not None:
+                batch = self._lift(batch)
             self.state, m = self.train_step(self.state, batch, self.rng)
             metrics.update(m)
             if profiler:
@@ -155,7 +222,7 @@ class TrainingDriver:
         2/4) ticks per batch as batches are consumed into chunks."""
         metrics = EpochMetrics()
         bufs: dict = {}
-        for b in iterate_tqdm(loader, self.verbosity):
+        for b in iterate_tqdm(_Prefetcher(iter(loader)), self.verbosity):
             buf = bufs.setdefault(self._shape_key(b), [])
             buf.append(b)
             if len(buf) == self.scan_chunk:
@@ -207,7 +274,7 @@ class TrainingDriver:
                 pred_values[ih].append(out[mask])
                 true_values[ih].append(tgt[mask])
 
-        batches = (
+        batches = _Prefetcher(
             self._device_groups(loader) if self.mesh is not None else iter(loader)
         )
         for batch in batches:

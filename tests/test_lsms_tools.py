@@ -1,0 +1,74 @@
+"""Offline LSMS tooling units beyond the enthalpy test: compositional
+histogram cutoff (reference utils/lsms/compositional_histogram_cutoff.py:16-75)
+and the minmax-table config completion (config_utils.py:142-161)."""
+
+import os
+import pickle
+
+import numpy as np
+
+from hydragnn_tpu.tools.lsms import compositional_histogram_cutoff
+from hydragnn_tpu.utils.config_utils import update_config_minmax
+
+FE, PT = 26.0, 78.0
+
+
+def _write_lsms(path, protons):
+    """Minimal LSMS text file: header energy line + one row per atom
+    [protons, index, x, y, z, charge_density, magnetic_moment]."""
+    n = len(protons)
+    rows = [
+        f"{p:.1f} {i} {i*0.5:.3f} 0.0 0.0 {0.1*i:.3f} {0.2*i:.3f}"
+        for i, p in enumerate(protons)
+    ]
+    with open(path, "w") as f:
+        f.write("-1.234\n" + "\n".join(rows) + "\n")
+
+
+def pytest_histogram_cutoff_caps_bins(tmp_path):
+    src = tmp_path / "lsms_raw"
+    os.makedirs(src)
+    # Compositions strictly inside bins (bin edges fall into the last bin, the
+    # reference find_bin quirk): 3/8 Fe = 0.375 → bin 1; 5/8 Fe = 0.625 → bin 2.
+    # Cutoff 4 keeps at most 3 per bin (reference increments then compares <).
+    for i in range(10):
+        _write_lsms(src / f"lean_{i}.txt", [FE] * 3 + [PT] * 5)
+    for i in range(3):
+        _write_lsms(src / f"rich_{i}.txt", [FE] * 5 + [PT] * 3)
+
+    kept, bin_counts = compositional_histogram_cutoff(
+        str(src), [FE, PT], histogram_cutoff=4, num_bins=5, create_plots=False
+    )
+    out_dir = str(src) + "_histogram_cutoff/"
+    survivors = sorted(os.listdir(out_dir))
+    assert len(survivors) == len(kept)
+    assert bin_counts.sum() == 13
+    comps = np.asarray(kept)
+    assert (comps == 0.375).sum() == 3  # capped bin: 10 seen, 3 kept
+    assert (comps == 0.625).sum() == 3  # under cutoff: all 3 kept
+    for s in survivors:  # symlinks resolve to originals
+        assert os.path.islink(os.path.join(out_dir, s))
+
+    # second call without overwrite refuses and returns empty
+    kept2, _ = compositional_histogram_cutoff(
+        str(src), [FE, PT], 4, 5, create_plots=False
+    )
+    assert kept2.size == 0
+
+
+def pytest_update_config_minmax(tmp_path):
+    node_minmax = np.array([[0.0, -1.0, 5.0], [10.0, 1.0, 15.0]])  # [2, feats]
+    graph_minmax = np.array([[100.0], [200.0]])
+    pkl = tmp_path / "ds.pkl"
+    with open(pkl, "wb") as f:
+        pickle.dump(node_minmax, f)
+        pickle.dump(graph_minmax, f)
+
+    var_config = {
+        "input_node_features": [0, 2],
+        "type": ["graph", "node"],
+        "output_index": [0, 1],
+    }
+    out = update_config_minmax(str(pkl), var_config)
+    assert out["x_minmax"] == [[0.0, 10.0], [5.0, 15.0]]
+    assert out["y_minmax"] == [[100.0, 200.0], [-1.0, 1.0]]
