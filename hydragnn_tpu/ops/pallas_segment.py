@@ -43,8 +43,10 @@ Pallas interpreter for exact parity with what compiles on TPU). Set
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+from contextvars import ContextVar
 from typing import Optional, Tuple
 
 import jax
@@ -55,14 +57,39 @@ from . import segment as seg
 _BN = 128  # node-block rows (one MXU tile edge)
 _BE = 512  # edge-block columns per grid step
 
+# Platform the gating decisions see. jax.default_backend() is a process-global
+# property and is WRONG in mixed-platform environments (e.g. a TPU-attached
+# host tracing a step for a CPU-device mesh): the gate must reflect the
+# platform of the devices that will execute the op. Step builders pin it for
+# the duration of tracing via pallas_platform(). ContextVar so concurrent
+# traces for different-platform meshes don't cross-contaminate.
+_PLATFORM_OVERRIDE: ContextVar[Optional[str]] = ContextVar(
+    "hydragnn_pallas_platform", default=None
+)
+
+
+@contextlib.contextmanager
+def pallas_platform(platform: Optional[str]):
+    """Pin the execution platform Pallas gating sees while tracing a step
+    destined for specific devices (e.g. a CPU mesh on a TPU-attached host)."""
+    token = _PLATFORM_OVERRIDE.set(platform)
+    try:
+        yield
+    finally:
+        _PLATFORM_OVERRIDE.reset(token)
+
+
+def _platform() -> str:
+    return _PLATFORM_OVERRIDE.get() or jax.default_backend()
+
 
 def pallas_enabled() -> bool:
-    """True when the fused kernel should run (TPU backend, unless overridden
-    by HYDRAGNN_PALLAS=0/1)."""
+    """True when the fused kernel should run (TPU execution platform, unless
+    overridden by HYDRAGNN_PALLAS=0/1)."""
     env = os.environ.get("HYDRAGNN_PALLAS")
     if env is not None:
         return env not in ("0", "false", "False")
-    return jax.default_backend() == "tpu"
+    return _platform() == "tpu"
 
 
 def _round_up(x: int, m: int) -> int:
@@ -288,7 +315,7 @@ def fused_segment_stats(
     if mask is not None:
         ids = jnp.where(mask, ids, -1)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _platform() != "tpu"
     return _stats(data, ids, num_segments, eps, axis_name, interpret, want_std)
 
 

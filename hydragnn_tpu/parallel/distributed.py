@@ -85,11 +85,20 @@ def get_device_list():
 
 
 def make_mesh(
-    data_axis: Optional[int] = None, graph_axis: int = 1
+    data_axis: Optional[int] = None,
+    graph_axis: int = 1,
+    devices=None,
 ) -> jax.sharding.Mesh:
     """Device mesh for the train step: 'data' (batch/DP) × 'graph'
-    (intra-graph node/edge sharding — the long-context analog axis)."""
-    n = len(jax.devices())
+    (intra-graph node/edge sharding — the long-context analog axis).
+
+    ``devices``: explicit device list (e.g. ``jax.devices("cpu")`` to build a
+    virtual CPU mesh on a TPU-attached host); defaults to ``jax.devices()``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
     if graph_axis < 1 or graph_axis > n:
         raise ValueError(
             f"graph_axis={graph_axis} must be in [1, {n}] (device count)"
@@ -106,7 +115,7 @@ def make_mesh(
             f"mesh {data_axis}x{graph_axis} needs {data_axis * graph_axis} "
             f"devices but only {n} are available"
         )
-    devices = np.asarray(jax.devices()[: data_axis * graph_axis]).reshape(
+    grid = np.asarray(devices[: data_axis * graph_axis]).reshape(
         data_axis, graph_axis
     )
-    return jax.sharding.Mesh(devices, ("data", "graph"))
+    return jax.sharding.Mesh(grid, ("data", "graph"))

@@ -29,6 +29,14 @@ from jax.sharding import PartitionSpec as P
 from ..graphs.batch import GraphBatch
 from ..models.base import HydraGNN
 from ..models.loss import multihead_rmse_loss
+from ..ops.pallas_segment import pallas_platform
+
+
+def _mesh_platform(mesh) -> str:
+    """Platform of the devices a mesh's step will execute on — what the Pallas
+    gating must key off (jax.default_backend() lies when a TPU-attached host
+    traces a step for a CPU-device mesh)."""
+    return next(iter(mesh.devices.flat)).platform
 
 
 @struct.dataclass
@@ -266,15 +274,20 @@ def make_train_step_dp(
         )
         return new_state, {"loss": loss_sum, "rmses": rmses_sum, "count": count_sum}
 
+    platform = _mesh_platform(mesh)
+
     def step(state, batch, rng):
-        sharded = shard_map(
-            _local,
-            mesh=mesh,
-            in_specs=(P(), _batch_pspec(batch, graph_sharded), P()),
-            out_specs=(P(), P()),
-            check_rep=False,
-        )
-        return sharded(state, batch, rng)
+        # Tracing happens inside this call: pin the Pallas gate to the mesh's
+        # execution platform for the duration.
+        with pallas_platform(platform):
+            sharded = shard_map(
+                _local,
+                mesh=mesh,
+                in_specs=(P(), _batch_pspec(batch, graph_sharded), P()),
+                out_specs=(P(), P()),
+                check_rep=False,
+            )
+            return sharded(state, batch, rng)
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
@@ -301,15 +314,18 @@ def make_eval_step_dp(model: HydraGNN, mesh) -> Callable:
         outputs = [o[None] for o in outputs]  # restore device axis for gather
         return metrics, outputs
 
+    platform = _mesh_platform(mesh)
+
     def step(state, batch):
-        sharded = shard_map(
-            _local,
-            mesh=mesh,
-            in_specs=(P(), _batch_pspec(batch, graph_sharded)),
-            out_specs=(P(), [P("data") for _ in model.output_dim]),
-            check_rep=False,
-        )
-        return sharded(state, batch)
+        with pallas_platform(platform):
+            sharded = shard_map(
+                _local,
+                mesh=mesh,
+                in_specs=(P(), _batch_pspec(batch, graph_sharded)),
+                out_specs=(P(), [P("data") for _ in model.output_dim]),
+                check_rep=False,
+            )
+            return sharded(state, batch)
 
     return jax.jit(step)
 
