@@ -278,7 +278,13 @@ class TrainingDriver:
             self._device_groups(loader) if self.mesh is not None else iter(loader)
         )
         for batch in batches:
-            m, outputs = self.eval_step(self.state, batch)
+            # Same multi-host lift as train_epoch: the sharded eval step wants
+            # a GLOBAL [D_global, ...] array; each process only stacked its
+            # local slice. consume() keeps the host-local batch (its masks and
+            # targets are this process's rows, like the reference's per-rank
+            # test() lists).
+            lifted = self._lift(batch) if self.mesh is not None else batch
+            m, outputs = self.eval_step(self.state, lifted)
             metrics.update(m)
             if return_values:
                 consume(batch, outputs)

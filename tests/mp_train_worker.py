@@ -34,6 +34,27 @@ def main():
     history = hydragnn_tpu.run_training(config, mesh=mesh)
     print(f"FINAL_LOSS {history['total_loss_train'][-1]:.10f}", flush=True)
 
+    # Convergence mode (the reference CI's mpirun -n 2 pytest scope): run
+    # prediction through the SAME global mesh and enforce the unchanged
+    # single-process accuracy thresholds "rmse mae maxae" on every rank.
+    thresholds = os.environ.get("HYDRAGNN_MP_THRESHOLDS")
+    if thresholds:
+        import numpy as np
+
+        rmse_thr, mae_thr, maxae_thr = (float(t) for t in thresholds.split())
+        error, rmse_task, true_values, pred_values = hydragnn_tpu.run_prediction(
+            config, mesh=mesh
+        )
+        assert error < rmse_thr, f"total RMSE {error} >= {rmse_thr}"
+        for ihead, (tv, pv) in enumerate(zip(true_values, pred_values)):
+            assert rmse_task[ihead] < rmse_thr, (
+                f"head {ihead} RMSE {rmse_task[ihead]} >= {rmse_thr}"
+            )
+            err = np.abs(np.asarray(tv) - np.asarray(pv))
+            assert err.mean() < mae_thr, f"head {ihead} MAE {err.mean()}"
+            assert err.max() < maxae_thr, f"head {ihead} max {err.max()}"
+        print(f"CONVERGENCE_OK {error:.10f}", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -27,18 +27,17 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.mpi_skip
-def pytest_two_process_dp_training(tmp_path):
-    with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
-        config = json.load(f)
-    config["NeuralNetwork"]["Training"]["num_epoch"] = 3
-    config["Visualization"] = {"create_plots": False}
+def _make_split_datasets(config, tmp_path, counts):
+    """Point each config split at a freshly generated dataset under tmp_path."""
     for split in list(config["Dataset"]["path"]):
         p = str(tmp_path / f"dataset/unit_test_singlehead_{split}")
         config["Dataset"]["path"][split] = p
         os.makedirs(p, exist_ok=True)
-        n = {"train": 48, "test": 16, "validate": 16}[split]
-        deterministic_graph_data(p, number_configurations=n)
+        deterministic_graph_data(p, number_configurations=counts[split])
+
+
+def _launch_two_process(config, tmp_path, extra_env=None, timeout=420):
+    """Write config, spawn 2 rendezvousing workers, return their outputs."""
     config_path = str(tmp_path / "config.json")
     with open(config_path, "w") as f:
         json.dump(config, f)
@@ -56,6 +55,7 @@ def pytest_two_process_dp_training(tmp_path):
             HYDRAGNN_WORLD_SIZE="1",  # workers run scripts, not pytest
             SERIALIZED_DATA_PATH=str(tmp_path),
         )
+        env.update(extra_env or {})
         procs.append(
             subprocess.Popen(
                 [sys.executable, os.path.join(REPO, "tests/mp_train_worker.py"),
@@ -68,7 +68,7 @@ def pytest_two_process_dp_training(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -76,6 +76,20 @@ def pytest_two_process_dp_training(tmp_path):
         outs.append(out)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+    return outs
+
+
+@pytest.mark.mpi_skip
+def pytest_two_process_dp_training(tmp_path):
+    with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 3
+    config["Visualization"] = {"create_plots": False}
+    _make_split_datasets(
+        config, tmp_path, {"train": 48, "test": 16, "validate": 16}
+    )
+
+    outs = _launch_two_process(config, tmp_path)
 
     losses = []
     for out in outs:
@@ -90,3 +104,34 @@ def pytest_two_process_dp_training(tmp_path):
     assert any(
         os.path.exists(tmp_path / "logs" / d / (d + ".pk")) for d in logdirs
     )
+
+
+@pytest.mark.mpi_skip
+def pytest_two_process_pna_convergence(tmp_path):
+    """Full PNA ci.json convergence under 2 processes with the UNCHANGED
+    single-process accuracy thresholds (reference CI runs its whole suite via
+    mpirun -n 2, /root/reference/.github/workflows/CI.yml:47-52) — thresholds
+    from tests/test_graphs.py THRESHOLDS['PNA']."""
+    with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["Visualization"] = {"create_plots": False}
+    perc_train = config["NeuralNetwork"]["Training"]["perc_train"]
+    num_samples_tot = 500
+    _make_split_datasets(
+        config, tmp_path, {
+            "train": int(num_samples_tot * perc_train),
+            "test": int(num_samples_tot * (1 - perc_train) * 0.5),
+            "validate": int(num_samples_tot * (1 - perc_train) * 0.5),
+        },
+    )
+
+    outs = _launch_two_process(
+        config,
+        tmp_path,
+        extra_env={"HYDRAGNN_MP_THRESHOLDS": "0.20 0.20 0.75"},
+        timeout=900,
+    )
+    for out in outs:
+        assert any(
+            l.startswith("CONVERGENCE_OK") for l in out.splitlines()
+        ), out[-2000:]
