@@ -1,187 +1,259 @@
-"""Config system: JSON schema identical to the reference, including data-driven
-completion (reference /root/reference/hydragnn/utils/config_utils.py:17-195).
+"""Data-driven config completion.
 
-``update_config`` fills Architecture fields from the first training sample:
-output_dim/output_type from the packed y_loc, input_dim from selected features,
-the PNA degree histogram from the train set, edge_dim validation, and defaults —
-then pushes the inferred head spec into the data loaders (which need it to emit
-per-head dense targets)."""
+Accepts the reference's JSON schema (/root/reference/hydragnn/utils/
+config_utils.py:17-195 describes the contract: infer output_dim/output_type
+from the packed y_loc of the first training sample, input_dim from the
+selected node features, the PNA degree histogram from the train set, edge_dim
+from the declared edge features, then apply defaults) and produces the same
+completed config — pinned by the golden tests in
+tests/test_config_completion.py.
+
+The implementation is organized as a completion PIPELINE over a small context:
+each stage is a function of (config, ctx) run in order by ``update_config``,
+with per-head logic driven by a kind→handler dispatch table and the trailing
+defaults/log-name encoding declared as data.
+"""
 
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict
+from dataclasses import dataclass
+from typing import Any, Dict, List
 
 from ..preprocess.graph_build import check_if_graph_size_variable
 from .model import calculate_PNA_degree
 
+# Conv stacks that consume per-edge feature vectors.
+_EDGE_FEATURE_MODELS = frozenset({"PNA", "CGCNN"})
 
-def update_config(config: Dict[str, Any], train_loader, val_loader, test_loader):
-    graph_size_variable = check_if_graph_size_variable(
-        train_loader.dataset, val_loader.dataset, test_loader.dataset
-    )
+# Trailing defaults: (path into config, key, default value).
+_DEFAULTS = (
+    (("NeuralNetwork", "Architecture"), "freeze_conv_layers", False),
+    (("NeuralNetwork", "Architecture"), "initial_bias", None),
+    (("NeuralNetwork", "Training"), "optimizer", "AdamW"),
+)
 
-    if "Dataset" in config:
-        check_output_dim_consistent(train_loader.dataset[0], config)
+# Log-name encoding: "<tag><value>" segments in this order, then the two
+# list-valued trailers appended by get_log_name_config.
+_LOG_NAME_FIELDS = (
+    ("", ("NeuralNetwork", "Architecture"), "model_type"),
+    ("-r-", ("NeuralNetwork", "Architecture"), "radius"),
+    ("-mnnn-", ("NeuralNetwork", "Architecture"), "max_neighbours"),
+    ("-ncl-", ("NeuralNetwork", "Architecture"), "num_conv_layers"),
+    ("-hd-", ("NeuralNetwork", "Architecture"), "hidden_dim"),
+    ("-ne-", ("NeuralNetwork", "Training"), "num_epoch"),
+    ("-lr-", ("NeuralNetwork", "Training"), "learning_rate"),
+    ("-bs-", ("NeuralNetwork", "Training"), "batch_size"),
+    ("-data-", ("Dataset",), "name"),
+)
 
-    config["NeuralNetwork"] = update_config_NN_outputs(
-        config["NeuralNetwork"], train_loader.dataset[0], graph_size_variable
-    )
-    config = normalize_output_config(config)
 
-    arch = config["NeuralNetwork"]["Architecture"]
-    voi = config["NeuralNetwork"]["Variables_of_interest"]
+def _at(config: Dict[str, Any], path) -> Dict[str, Any]:
+    for key in path:
+        config = config[key]
+    return config
+
+
+@dataclass
+class _Ctx:
+    """Everything the completion stages read besides the config itself."""
+
+    loaders: tuple
+    sample: Any  # first training sample
+    spans: List[int]  # per-head slice widths in the packed y vector
+    variable_size: bool
+
+
+def _head_spans(sample) -> List[int]:
+    offsets = [int(v) for v in sample.y_loc[0]]
+    return [b - a for a, b in zip(offsets, offsets[1:])]
+
+
+# ------------------------------------------------------------- per-head kinds
+def _head_dim(kind: str, span: int, ctx: _Ctx, arch: Dict[str, Any]) -> int:
+    if kind == "graph":
+        return span
+    if kind == "node":
+        if (
+            ctx.variable_size
+            and arch["output_heads"]["node"]["type"] == "mlp_per_node"
+        ):
+            raise ValueError(
+                "node head type 'mlp_per_node' needs every graph in the "
+                "dataset to have the same node count; switch NeuralNetwork."
+                "Architecture.output_heads.node.type to 'mlp' or 'conv'."
+            )
+        return span // ctx.sample.num_nodes
+    raise ValueError(f"unrecognized head kind: {kind!r}")
+
+
+# ----------------------------------------------------------- pipeline stages
+def _stage_check_declared_dims(config, ctx):
+    """Cross-check y_loc-derived widths against Dataset.*_features.dim."""
+    if "Dataset" not in config:
+        return
+    voi = _at(config, ("NeuralNetwork", "Variables_of_interest"))
+    declared = {
+        "graph": lambda span, i: span
+        == config["Dataset"]["graph_features"]["dim"][i],
+        "node": lambda span, i: span // ctx.sample.num_nodes
+        == config["Dataset"]["node_features"]["dim"][i],
+    }
+    for kind, index, span in zip(voi["type"], voi["output_index"], ctx.spans):
+        check = declared.get(kind)
+        if check is not None and not check(span, index):
+            raise AssertionError(
+                f"head of kind {kind!r} at output_index {index} does not match "
+                "the declared Dataset feature dimension"
+            )
+
+
+def _stage_infer_heads(config, ctx):
+    arch = _at(config, ("NeuralNetwork", "Architecture"))
+    voi = _at(config, ("NeuralNetwork", "Variables_of_interest"))
+    if len(voi["type"]) != len(ctx.spans):
+        raise ValueError(
+            f"config declares {len(voi['type'])} heads but the data's y_loc "
+            f"packs {len(ctx.spans)}"
+        )
+    arch["output_dim"] = [
+        _head_dim(kind, span, ctx, arch)
+        for kind, span in zip(voi["type"], ctx.spans)
+    ]
+    arch["output_type"] = voi["type"]
+    arch["num_nodes"] = ctx.sample.num_nodes
+
+
+def _stage_denormalize(config, ctx):
+    voi = _at(config, ("NeuralNetwork", "Variables_of_interest"))
+    if voi.get("denormalize_output"):
+        update_config_minmax(_serialized_dataset_path(config), voi)
+    else:
+        voi["denormalize_output"] = False
+
+
+def _stage_input_dim(config, ctx):
+    arch = _at(config, ("NeuralNetwork", "Architecture"))
+    voi = _at(config, ("NeuralNetwork", "Variables_of_interest"))
     arch["input_dim"] = len(voi["input_node_features"])
 
-    if arch["model_type"] == "PNA":
-        deg = calculate_PNA_degree(train_loader.dataset, arch["max_neighbours"])
-        arch["pna_deg"] = deg.tolist()
+
+def _stage_pna_degree(config, ctx):
+    arch = _at(config, ("NeuralNetwork", "Architecture"))
+    arch["pna_deg"] = (
+        calculate_PNA_degree(
+            ctx.loaders[0].dataset, arch["max_neighbours"]
+        ).tolist()
+        if arch["model_type"] == "PNA"
+        else None
+    )
+
+
+def _stage_edge_dim(config, ctx):
+    arch = _at(config, ("NeuralNetwork", "Architecture"))
+    features = arch.get("edge_features")
+    if features:
+        assert arch["model_type"] in _EDGE_FEATURE_MODELS, (
+            "edge features are only supported by the "
+            f"{'/'.join(sorted(_EDGE_FEATURE_MODELS))} stacks"
+        )
+        arch["edge_dim"] = len(features)
+    elif arch["model_type"] == "CGCNN":
+        # CGCNN's gate MLP needs an integer edge width even with no features.
+        arch["edge_dim"] = 0
     else:
-        arch["pna_deg"] = None
+        arch["edge_dim"] = None
 
-    config["NeuralNetwork"]["Architecture"] = update_config_edge_dim(arch)
 
-    arch.setdefault("freeze_conv_layers", False)
-    arch.setdefault("initial_bias", None)
-    config["NeuralNetwork"]["Training"].setdefault("optimizer", "AdamW")
+def _stage_defaults(config, ctx):
+    for path, key, value in _DEFAULTS:
+        _at(config, path).setdefault(key, value)
 
-    # Push the inferred head spec into the loaders so batches carry targets.
-    for loader in (train_loader, val_loader, test_loader):
+
+def _stage_push_head_spec(config, ctx):
+    """Loaders need the inferred head spec to emit per-head dense targets."""
+    arch = _at(config, ("NeuralNetwork", "Architecture"))
+    for loader in ctx.loaders:
         loader.set_head_spec(arch["output_type"], arch["output_dim"])
         loader.edge_dim = arch["edge_dim"]
 
+
+_PIPELINE = (
+    _stage_check_declared_dims,
+    _stage_infer_heads,
+    _stage_denormalize,
+    _stage_input_dim,
+    _stage_pna_degree,
+    _stage_edge_dim,
+    _stage_defaults,
+    _stage_push_head_spec,
+)
+
+
+def update_config(config, train_loader, val_loader, test_loader):
+    """Complete a user config from the training data (the reference's
+    data-driven completion contract; output pinned by golden tests)."""
+    loaders = (train_loader, val_loader, test_loader)
+    sample = train_loader.dataset[0]
+    ctx = _Ctx(
+        loaders=loaders,
+        sample=sample,
+        spans=_head_spans(sample),
+        variable_size=check_if_graph_size_variable(
+            *(loader.dataset for loader in loaders)
+        ),
+    )
+    for stage in _PIPELINE:
+        stage(config, ctx)
     return config
 
 
-def update_config_edge_dim(arch: Dict[str, Any]) -> Dict[str, Any]:
-    arch["edge_dim"] = None
-    edge_models = ["PNA", "CGCNN"]
-    if "edge_features" in arch and arch["edge_features"]:
-        assert (
-            arch["model_type"] in edge_models
-        ), "Edge features can only be used with PNA and CGCNN."
-        arch["edge_dim"] = len(arch["edge_features"])
-    elif arch["model_type"] == "CGCNN":
-        # CGCNN always needs an integer edge_dim (config_utils.py:68-71).
-        arch["edge_dim"] = 0
-    return arch
-
-
-def check_output_dim_consistent(data, config: Dict[str, Any]) -> None:
-    output_type = config["NeuralNetwork"]["Variables_of_interest"]["type"]
-    output_index = config["NeuralNetwork"]["Variables_of_interest"]["output_index"]
-    for ihead in range(len(output_type)):
-        span = int(data.y_loc[0, ihead + 1]) - int(data.y_loc[0, ihead])
-        if output_type[ihead] == "graph":
-            assert (
-                span
-                == config["Dataset"]["graph_features"]["dim"][output_index[ihead]]
-            )
-        elif output_type[ihead] == "node":
-            assert (
-                span // data.num_nodes
-                == config["Dataset"]["node_features"]["dim"][output_index[ihead]]
-            )
-
-
-def update_config_NN_outputs(
-    nn_config: Dict[str, Any], data, graph_size_variable: bool
-) -> Dict[str, Any]:
-    output_type = nn_config["Variables_of_interest"]["type"]
-    dims_list = []
-    for ihead in range(len(output_type)):
-        span = int(data.y_loc[0, ihead + 1]) - int(data.y_loc[0, ihead])
-        if output_type[ihead] == "graph":
-            dim_item = span
-        elif output_type[ihead] == "node":
-            if (
-                graph_size_variable
-                and nn_config["Architecture"]["output_heads"]["node"]["type"]
-                == "mlp_per_node"
-            ):
-                raise ValueError(
-                    '"mlp_per_node" is not allowed for variable graph size, Please '
-                    'set config["NeuralNetwork"]["Architecture"]["output_heads"]'
-                    '["node"]["type"] to be "mlp" or "conv" in input file.'
-                )
-            dim_item = span // data.num_nodes
-        else:
-            raise ValueError("Unknown output type", output_type[ihead])
-        dims_list.append(dim_item)
-    nn_config["Architecture"]["output_dim"] = dims_list
-    nn_config["Architecture"]["output_type"] = output_type
-    nn_config["Architecture"]["num_nodes"] = data.num_nodes
-    return nn_config
-
-
-def normalize_output_config(config: Dict[str, Any]) -> Dict[str, Any]:
-    var_config = config["NeuralNetwork"]["Variables_of_interest"]
-    if var_config.get("denormalize_output"):
-        if list(config["Dataset"]["path"].values())[0].endswith(".pkl"):
-            dataset_path = list(config["Dataset"]["path"].values())[0]
-        else:
-            base = os.environ["SERIALIZED_DATA_PATH"]
-            if "total" in config["Dataset"]["path"]:
-                dataset_path = (
-                    f"{base}/serialized_dataset/{config['Dataset']['name']}.pkl"
-                )
-            else:
-                dataset_path = (
-                    f"{base}/serialized_dataset/{config['Dataset']['name']}_train.pkl"
-                )
-        var_config = update_config_minmax(dataset_path, var_config)
-    else:
-        var_config["denormalize_output"] = False
-    config["NeuralNetwork"]["Variables_of_interest"] = var_config
-    return config
+# ------------------------------------------------------------------- minmax
+def _serialized_dataset_path(config) -> str:
+    """Where the pickled min/max tables live: the configured .pkl directly, or
+    the serialized dataset derived from SERIALIZED_DATA_PATH + dataset name
+    (the train shard when the config has per-split paths)."""
+    paths = config["Dataset"]["path"]
+    first = next(iter(paths.values()))
+    if first.endswith(".pkl"):
+        return first
+    stem = config["Dataset"]["name"] + ("" if "total" in paths else "_train")
+    return os.path.join(
+        os.environ["SERIALIZED_DATA_PATH"], "serialized_dataset", stem + ".pkl"
+    )
 
 
 def update_config_minmax(dataset_path: str, config: Dict[str, Any]):
-    """Load per-feature min/max tables pickled ahead of the dataset
-    (config_utils.py:142-161)."""
+    """Fill x_minmax/y_minmax from the per-feature min/max tables pickled
+    ahead of the serialized dataset samples."""
     with open(dataset_path, "rb") as f:
-        node_minmax = pickle.load(f)
-        graph_minmax = pickle.load(f)
-    config["x_minmax"] = []
-    config["y_minmax"] = []
-    for item in config["input_node_features"]:
-        config["x_minmax"].append(node_minmax[:, item].tolist())
-    for out_type, out_index in zip(config["type"], config["output_index"]):
-        if out_type == "graph":
-            config["y_minmax"].append(graph_minmax[:, out_index].tolist())
-        elif out_type == "node":
-            config["y_minmax"].append(node_minmax[:, out_index].tolist())
-        else:
-            raise ValueError("Unknown output type", out_type)
+        tables = {"node": pickle.load(f), "graph": pickle.load(f)}
+    config["x_minmax"] = [
+        tables["node"][:, i].tolist() for i in config["input_node_features"]
+    ]
+    y_minmax = []
+    for kind, index in zip(config["type"], config["output_index"]):
+        if kind not in tables:
+            raise ValueError(f"unrecognized head kind: {kind!r}")
+        y_minmax.append(tables[kind][:, index].tolist())
+    config["y_minmax"] = y_minmax
     return config
 
 
+# ----------------------------------------------------------------- log name
 def get_log_name_config(config: Dict[str, Any]) -> str:
-    """Hyperparameter-encoding log/checkpoint name (config_utils.py:164-195)."""
-    arch = config["NeuralNetwork"]["Architecture"]
-    train = config["NeuralNetwork"]["Training"]
-    voi = config["NeuralNetwork"]["Variables_of_interest"]
-    return (
-        arch["model_type"]
-        + "-r-"
-        + str(arch["radius"])
-        + "-mnnn-"
-        + str(arch["max_neighbours"])
-        + "-ncl-"
-        + str(arch["num_conv_layers"])
-        + "-hd-"
-        + str(arch["hidden_dim"])
-        + "-ne-"
-        + str(train["num_epoch"])
-        + "-lr-"
-        + str(train["learning_rate"])
-        + "-bs-"
-        + str(train["batch_size"])
-        + "-data-"
-        + config["Dataset"]["name"]
-        + "-node_ft-"
-        + "".join(str(x) for x in voi["input_node_features"])
-        + "-task_weights-"
-        + "".join(str(w) + "-" for w in arch["task_weights"])
+    """Hyperparameter-encoding log/checkpoint directory name (identical string
+    to the reference's encoding — checkpoints must resolve across both)."""
+    arch = _at(config, ("NeuralNetwork", "Architecture"))
+    voi = _at(config, ("NeuralNetwork", "Variables_of_interest"))
+    segments = [
+        f"{tag}{_at(config, path)[key]}" for tag, path, key in _LOG_NAME_FIELDS
+    ]
+    segments.append(
+        "-node_ft-" + "".join(str(f) for f in voi["input_node_features"])
     )
+    segments.append(
+        "-task_weights-" + "".join(f"{w}-" for w in arch["task_weights"])
+    )
+    return "".join(segments)

@@ -1,0 +1,191 @@
+"""Golden tests for data-driven config completion (reference
+/root/reference/hydragnn/utils/config_utils.py:17-195): the completed config
+for representative model families is pinned byte-for-byte in
+tests/golden/*.json, so any rewrite of the completion logic must reproduce the
+reference-compatible output exactly. Regenerate with
+``python tests/test_config_completion.py --regen`` (only when the completion
+CONTRACT deliberately changes)."""
+
+import copy
+import json
+import os
+import pickle
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hydragnn_tpu.graphs import GraphSample
+from hydragnn_tpu.preprocess.dataloader import GraphDataLoader
+from hydragnn_tpu.utils.config_utils import get_log_name_config, update_config
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+
+
+def _sample(rng, n, num_graph_feats=2, num_node_feats=1):
+    pos = rng.random((n, 3)).astype(np.float32)
+    x = rng.normal(size=(n, 2)).astype(np.float32)
+    y = np.concatenate(
+        [rng.normal(size=num_graph_feats), rng.normal(size=n * num_node_feats)]
+    ).astype(np.float32)
+    y_loc = np.array(
+        [[0, num_graph_feats, num_graph_feats + n * num_node_feats]], np.int64
+    )
+    k = min(4, n - 1)
+    senders = np.repeat(np.arange(n), k)
+    receivers = (senders + rng.integers(1, n, senders.shape)) % n
+    return GraphSample(
+        x=x, pos=pos, y=y, y_loc=y_loc,
+        edge_index=np.stack([senders, receivers]).astype(np.int64),
+        edge_attr=rng.random((senders.size, 1)).astype(np.float32),
+    )
+
+
+def _loaders(variable_size=False):
+    rng = np.random.default_rng(7)
+    sizes = (
+        [6, 8, 10, 7, 9, 6, 8, 10] if variable_size else [8] * 8
+    )
+    loaders = []
+    for chunk in (sizes[:4], sizes[4:6], sizes[6:]):
+        ds = [_sample(rng, n) for n in chunk]
+        loaders.append(GraphDataLoader(ds, batch_size=2, shuffle=False))
+    return loaders
+
+
+def _config(model_type="PNA", node_head="mlp", edge_features=None):
+    arch = {
+        "model_type": model_type,
+        "radius": 2.0,
+        "max_neighbours": 10,
+        "hidden_dim": 16,
+        "num_conv_layers": 2,
+        "task_weights": [1.0, 2.0],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            },
+            "node": {
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+                "type": node_head,
+            },
+        },
+    }
+    if edge_features is not None:
+        arch["edge_features"] = edge_features
+    return {
+        "Dataset": {
+            "name": "golden_unit",
+            "path": {"total": "./dataset/golden_unit"},
+            "graph_features": {"dim": [2]},
+            "node_features": {"dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": arch,
+            "Variables_of_interest": {
+                "input_node_features": [0, 1],
+                "type": ["graph", "node"],
+                "output_index": [0, 0],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 3,
+                "perc_train": 0.7,
+                "learning_rate": 0.005,
+                "batch_size": 2,
+            },
+        },
+        "Verbosity": {"level": 0},
+    }
+
+
+CASES = {
+    "pna": dict(model_type="PNA"),
+    "cgcnn_edges": dict(model_type="CGCNN", edge_features=["lengths"]),
+    "cgcnn_bare": dict(model_type="CGCNN"),
+    "gin": dict(model_type="GIN"),
+}
+
+
+def _complete(case_kwargs):
+    train, val, test = _loaders()
+    return update_config(copy.deepcopy(_config(**case_kwargs)), train, val, test)
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def pytest_completion_matches_golden(case):
+    completed = _complete(CASES[case])
+    with open(os.path.join(GOLDEN_DIR, f"config_{case}.json")) as f:
+        golden = json.load(f)
+    # json round-trip normalizes tuples/ints exactly like the golden dump.
+    assert json.loads(json.dumps(completed)) == golden
+
+
+def pytest_log_name_matches_golden():
+    completed = _complete(CASES["pna"])
+    with open(os.path.join(GOLDEN_DIR, "log_name_pna.txt")) as f:
+        assert get_log_name_config(completed) == f.read().strip()
+
+
+def pytest_head_spec_pushed_into_loaders():
+    train, val, test = _loaders()
+    update_config(copy.deepcopy(_config()), train, val, test)
+    for loader in (train, val, test):
+        assert loader.head_types == ("graph", "node")
+        assert loader.head_dims == (2, 1)
+        assert loader.edge_dim is None
+
+
+def pytest_mlp_per_node_rejected_for_variable_graphs():
+    train, val, test = _loaders(variable_size=True)
+    with pytest.raises(ValueError, match="mlp_per_node"):
+        update_config(
+            copy.deepcopy(_config(node_head="mlp_per_node")), train, val, test
+        )
+
+
+def pytest_edge_features_rejected_off_pna_cgcnn():
+    train, val, test = _loaders()
+    with pytest.raises(AssertionError):
+        update_config(
+            copy.deepcopy(_config(model_type="GIN", edge_features=["lengths"])),
+            train, val, test,
+        )
+
+
+def pytest_denormalize_loads_minmax(tmp_path):
+    node_minmax = np.array([[0.0, -1.0], [2.0, 3.0]])
+    graph_minmax = np.array([[-4.0], [5.0]])
+    pkl = tmp_path / "golden_unit.pkl"
+    with open(pkl, "wb") as f:
+        pickle.dump(node_minmax, f)
+        pickle.dump(graph_minmax, f)
+    cfg = _config()
+    cfg["Dataset"]["path"] = {"total": str(pkl)}
+    cfg["NeuralNetwork"]["Variables_of_interest"]["denormalize_output"] = True
+    train, val, test = _loaders()
+    completed = update_config(copy.deepcopy(cfg), train, val, test)
+    voi = completed["NeuralNetwork"]["Variables_of_interest"]
+    assert voi["x_minmax"] == [[0.0, 2.0], [-1.0, 3.0]]
+    assert voi["y_minmax"] == [[-4.0, 5.0], [0.0, 2.0]]
+
+
+def _regen():
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    for case, kwargs in CASES.items():
+        completed = _complete(kwargs)
+        with open(os.path.join(GOLDEN_DIR, f"config_{case}.json"), "w") as f:
+            json.dump(json.loads(json.dumps(completed)), f, indent=1, sort_keys=True)
+    with open(os.path.join(GOLDEN_DIR, "log_name_pna.txt"), "w") as f:
+        f.write(get_log_name_config(_complete(CASES["pna"])) + "\n")
+    print(f"regenerated goldens in {GOLDEN_DIR}")
+
+
+if __name__ == "__main__" and "--regen" in sys.argv:
+    _regen()
