@@ -63,10 +63,17 @@ class _Prefetcher:
             except BaseException as e:  # propagate to consumer
                 self._err = e
             finally:
-                try:
-                    self._q.put_nowait(self._SENTINEL)
-                except queue.Full:
-                    pass  # consumer gone; cancel() drains
+                # The sentinel must not be dropped: with the queue full (>=
+                # depth batches and a momentarily slow consumer) put_nowait
+                # would raise Full, the consumer would drain the items and
+                # then block on get() forever. Block with cancel checks,
+                # exactly like regular items.
+                while not self._cancel.is_set():
+                    try:
+                        self._q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         self._thread = threading.Thread(
             target=_run, name="hydragnn-prefetch", daemon=True
@@ -205,11 +212,21 @@ class TrainingDriver:
         batches = _Prefetcher(
             self._device_groups(loader) if self.mesh is not None else iter(loader)
         )
-        for batch in iterate_tqdm(batches, self.verbosity):
-            if self.mesh is not None:
-                batch = self._lift(batch)
-            self.state, m = self.train_step(self.state, batch, self.rng)
-            metrics.update(m)
+        prof = profiler or Profiler()
+        batch_iter = iter(iterate_tqdm(batches, self.verbosity))
+        while True:
+            # "feed" covers batch ACQUISITION (the prefetcher queue wait —
+            # where an input-bound pipeline actually stalls) plus the
+            # multi-host lift, not just the lift.
+            with prof.annotate("feed"):
+                batch = next(batch_iter, None)
+                if batch is None:
+                    break
+                if self.mesh is not None:
+                    batch = self._lift(batch)
+            with prof.annotate("train_step"):
+                self.state, m = self.train_step(self.state, batch, self.rng)
+                metrics.update(m)
             if profiler:
                 profiler.step()
         return metrics.averages()
@@ -242,10 +259,11 @@ class TrainingDriver:
         metrics.update(m)
 
     # ------------------------------------------------------------------- eval
-    def evaluate(self, loader, return_values: bool = False):
+    def evaluate(self, loader, return_values: bool = False, profiler=None):
         """validate()/test() analog. With return_values, also gathers per-head
         (true, predicted) arrays over real rows (test(), reference
         train_validate_test.py:267-304)."""
+        prof = profiler or Profiler()
         metrics = EpochMetrics()
         num_heads = len(self.model.output_dim)
         true_values: List[List[np.ndarray]] = [[] for _ in range(num_heads)]
@@ -284,8 +302,9 @@ class TrainingDriver:
             # targets are this process's rows, like the reference's per-rank
             # test() lists).
             lifted = self._lift(batch) if self.mesh is not None else batch
-            m, outputs = self.eval_step(self.state, lifted)
-            metrics.update(m)
+            with prof.annotate("eval_step"):
+                m, outputs = self.eval_step(self.state, lifted)
+                metrics.update(m)
             if return_values:
                 consume(batch, outputs)
 
@@ -344,13 +363,19 @@ def train_validate_test(
             profiler.set_current_epoch(epoch)
 
         train_loss, train_rmses = driver.train_epoch(train_loader, profiler)
-        val_loss, val_rmses = driver.evaluate(val_loader)
-        test_loss, test_rmses = driver.evaluate(test_loader)
+        val_loss, val_rmses = driver.evaluate(val_loader, profiler=profiler)
+        test_loss, test_rmses = driver.evaluate(test_loader, profiler=profiler)
 
         if scheduler is not None:
             current_lr = get_learning_rate(driver.state.opt_state)
-            new_lr = scheduler.step(val_loss, current_lr)
-            if new_lr != current_lr:
+            # None = no injected LR knob (LBFGS: linesearch owns the step
+            # size) — the plateau scheduler has nothing to act on.
+            new_lr = (
+                scheduler.step(val_loss, current_lr)
+                if current_lr is not None
+                else None
+            )
+            if new_lr is not None and new_lr != current_lr:
                 driver.state = driver.state.replace(
                     opt_state=set_learning_rate(driver.state.opt_state, new_lr)
                 )

@@ -1,9 +1,16 @@
-"""Epoch-targeted profiler window (reference /root/reference/hydragnn/utils/
-profile.py:9-68 wraps torch.profiler; here jax.profiler traces to TensorBoard).
+"""Step-windowed profiler (reference /root/reference/hydragnn/utils/
+profile.py:9-68 wraps torch.profiler with a wait=1/warmup=1/active=3 step
+schedule inside a target epoch; here jax.profiler traces to TensorBoard).
 
-Config surface is identical: ``"Profile": {"enable": 1, "target_epoch": N}``; the
-trace covers the target epoch's train loop and lands under
-./logs/<name>/profiler_output for TensorBoard / Perfetto."""
+Config surface is a superset of the reference's:
+``"Profile": {"enable": 1, "target_epoch": N, "wait": 1, "warmup": 1,
+"active": 3}`` — within the target epoch, ``wait + warmup`` train steps run
+untraced (compile/cache effects settle), then exactly ``active`` steps are
+captured. ``active: 0`` falls back to tracing the whole epoch. The trace
+lands under ./logs/<name>/profiler_output for TensorBoard / Perfetto.
+
+``annotate(name)`` opens a named span (torch ``record_function`` analog);
+the TrainingDriver wraps feed / train_step / eval_step with it."""
 
 from __future__ import annotations
 
@@ -19,7 +26,13 @@ class Profiler:
         self.enabled = False
         self.target_epoch: Optional[int] = None
         self.trace_dir = os.path.join(prefix, "profiler_output")
-        self._active = False
+        # Step schedule within the target epoch (reference profile.py:23).
+        self.wait = 1
+        self.warmup = 1
+        self.active_steps = 3
+        self._armed = False  # inside the target epoch
+        self._tracing = False  # jax trace window open
+        self._step = 0
 
     def setup(self, config: Optional[dict]) -> None:
         """config = the optional "Profile" block of the run config."""
@@ -27,33 +40,56 @@ class Profiler:
             return
         self.enabled = bool(config.get("enable", 0))
         self.target_epoch = config.get("target_epoch", 0)
+        self.wait = int(config.get("wait", 1))
+        self.warmup = int(config.get("warmup", 1))
+        self.active_steps = int(config.get("active", 3))
 
     def set_current_epoch(self, epoch: int) -> None:
         if not self.enabled:
             return
-        if epoch == self.target_epoch and not self._active:
-            os.makedirs(self.trace_dir, exist_ok=True)
-            jax.profiler.start_trace(self.trace_dir)
-            self._active = True
-        elif self._active and epoch != self.target_epoch:
+        if epoch == self.target_epoch and not self._armed:
+            self._armed = True
+            self._step = 0
+            # Whole-epoch window, or a schedule with no wait/warmup: the
+            # trace must open before the first step runs.
+            if self.active_steps <= 0 or self.wait + self.warmup == 0:
+                self._start()
+        elif self._armed and epoch != self.target_epoch:
             self.stop()
 
     @property
     def active(self) -> bool:
-        """True while a trace window is open (drives the per-step train path —
+        """True inside the target epoch (drives the per-step train path —
         scanned epochs would hide step boundaries from the trace)."""
-        return self._active
+        return self._armed
 
     def step(self) -> None:
-        """Per-batch hook kept for API parity (jax traces need no step marker)."""
+        """Per-train-step hook: advances the wait/warmup/active schedule."""
+        if not self._armed or self.active_steps <= 0:
+            return
+        self._step += 1
+        skip = self.wait + self.warmup
+        if self._step == skip and not self._tracing:
+            self._start()
+        elif self._step == skip + self.active_steps and self._tracing:
+            self._stop_trace()
 
     def annotate(self, name: str):
         """Named span (record_function analog) inside the trace."""
-        if self._active:
+        if self._armed:
             return jax.profiler.TraceAnnotation(name)
         return contextlib.nullcontext()
 
+    def _start(self) -> None:
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self.trace_dir)
+        self._tracing = True
+
+    def _stop_trace(self) -> None:
+        jax.profiler.stop_trace()
+        self._tracing = False
+
     def stop(self) -> None:
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+        if self._tracing:
+            self._stop_trace()
+        self._armed = False

@@ -45,8 +45,9 @@ def pytest_timer_misuse_raises():
 
 
 def pytest_profiler_epoch_window(tmp_path):
+    # active: 0 = whole-epoch trace window (pre-schedule behavior).
     prof = Profiler(str(tmp_path))
-    prof.setup({"enable": 1, "target_epoch": 1})
+    prof.setup({"enable": 1, "target_epoch": 1, "active": 0})
     assert prof.enabled and not prof.active
     prof.set_current_epoch(0)
     assert not prof.active
@@ -60,6 +61,101 @@ def pytest_profiler_epoch_window(tmp_path):
     # trace files actually written
     found = any(files for _, _, files in os.walk(prof.trace_dir))
     assert found, "no profiler trace output"
+
+
+def pytest_profiler_step_schedule(tmp_path, monkeypatch):
+    """wait=1/warmup=1/active=3 (the reference's torch.profiler schedule,
+    profile.py:23): trace opens after wait+warmup steps, captures exactly
+    ``active`` steps, then closes — all within the target epoch."""
+    events = []
+    monkeypatch.setattr(
+        "jax.profiler.start_trace", lambda d: events.append("start")
+    )
+    monkeypatch.setattr(
+        "jax.profiler.stop_trace", lambda: events.append("stop")
+    )
+    prof = Profiler(str(tmp_path))
+    prof.setup(
+        {"enable": 1, "target_epoch": 0, "wait": 1, "warmup": 1, "active": 3}
+    )
+    prof.set_current_epoch(0)
+    transitions = {}
+    for i in range(8):
+        prof.step()
+        transitions[i + 1] = tuple(events)
+    assert transitions[1] == ()  # wait
+    assert transitions[2] == ("start",)  # trace opens after wait+warmup
+    assert transitions[4] == ("start",)  # active steps 3,4,5 captured
+    assert transitions[5] == ("start", "stop")  # closes after 3 active steps
+    assert transitions[8] == ("start", "stop")  # no re-open
+    prof.set_current_epoch(1)
+    assert events == ["start", "stop"]
+
+
+def pytest_profiler_spans_in_trace(tmp_path):
+    """Drive a real train epoch under the profiler and assert the
+    feed/train_step span names (and eval_step via evaluate) land in the
+    written trace — the record_function-parity check."""
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.graphs import GraphSample, collate_graphs
+    from hydragnn_tpu.models import create_model, init_model_variables
+    from hydragnn_tpu.preprocess.dataloader import GraphDataLoader
+    from hydragnn_tpu.train.train_validate_test import TrainingDriver
+    from hydragnn_tpu.train.trainer import create_train_state
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(8):
+        n = 6
+        x = rng.normal(size=(n, 1)).astype(np.float32)
+        senders = np.repeat(np.arange(n), 2)
+        receivers = (senders + 1 + np.arange(senders.size) % (n - 1)) % n
+        samples.append(
+            GraphSample(
+                x=x,
+                pos=rng.random((n, 3)).astype(np.float32),
+                y=np.array([x.sum()], np.float32),
+                y_loc=np.array([[0, 1]], np.int64),
+                edge_index=np.stack([senders, receivers]).astype(np.int64),
+            )
+        )
+    loader = GraphDataLoader(samples, batch_size=4, shuffle=False)
+    loader.set_head_spec(("graph",), (1,))
+    heads = {
+        "graph": {
+            "num_sharedlayers": 1,
+            "dim_sharedlayers": 4,
+            "num_headlayers": 1,
+            "dim_headlayers": [4],
+        }
+    }
+    model = create_model("SAGE", 1, 8, (1,), ("graph",), heads, [1.0], 2)
+    batch = next(iter(loader))
+    variables = init_model_variables(model, batch)
+    opt = select_optimizer("AdamW", 1e-3)
+    state = create_train_state(model, variables, opt)
+    driver = TrainingDriver(model, opt, state)
+
+    # Whole-epoch window (active: 0) keeps the trace open across the eval
+    # pass too, so all three span names must land in the written trace.
+    prof = Profiler(str(tmp_path))
+    prof.setup({"enable": 1, "target_epoch": 0, "active": 0})
+    prof.set_current_epoch(0)
+    driver.train_epoch(loader, prof)
+    driver.evaluate(loader, profiler=prof)
+    prof.stop()
+
+    blobs = b""
+    for root, _, files in os.walk(prof.trace_dir):
+        for f in files:
+            with open(os.path.join(root, f), "rb") as fh:
+                blobs += fh.read()
+    assert b"train_step" in blobs, "train_step span missing from trace"
+    assert b"feed" in blobs, "feed span missing from trace"
+    assert b"eval_step" in blobs, "eval_step span missing from trace"
 
 
 def pytest_profiler_disabled_noop(tmp_path):
@@ -133,3 +229,23 @@ def pytest_verbosity_gating(capsys):
     # iterate_tqdm passes items through at any verbosity
     assert list(iterate_tqdm(range(3), 0)) == [0, 1, 2]
     assert list(iterate_tqdm(range(3), 2)) == [0, 1, 2]
+
+
+def pytest_prefetcher_sentinel_not_dropped_when_queue_full():
+    """Regression: the producer used put_nowait for the end-of-iteration
+    sentinel; with >= depth items queued and a slow consumer the sentinel hit
+    queue.Full and was silently dropped, leaving the consumer blocked on
+    get() forever (reproduced via run_training with 8 train batches)."""
+    import threading
+    import time as _time
+
+    from hydragnn_tpu.train.train_validate_test import _Prefetcher
+
+    pf = _Prefetcher(iter(range(6)), depth=2)
+    _time.sleep(0.3)  # producer fills the queue and finishes its iterable
+    got = []
+    t = threading.Thread(target=lambda: got.extend(pf), daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "consumer deadlocked waiting for sentinel"
+    assert got == list(range(6))
