@@ -123,6 +123,9 @@ def state_donation_safe(state: TrainState) -> bool:
 def _step_body(model: HydraGNN, optimizer):
     """The single-device gradient step shared by make_train_step and the
     scanned epoch (one definition — the two compiled paths must never drift)."""
+    from ..utils.optimizer import ValueFnTransformation
+
+    needs_value_fn = isinstance(optimizer, ValueFnTransformation)
 
     def body(state: TrainState, batch: GraphBatch, rng):
         dropout_key = jax.random.fold_in(rng, state.step)
@@ -131,7 +134,25 @@ def _step_body(model: HydraGNN, optimizer):
             has_aux=True,
         )
         (loss, (new_bstats, rmses)), grads = grad_fn(state.params)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        if needs_value_fn:
+            # LBFGS zoom linesearch: update() re-evaluates the loss along the
+            # search direction via value_fn (deterministic eval — same batch,
+            # same dropout key).
+            def value_fn(p):
+                return _loss_and_metrics(
+                    model, p, state.batch_stats, batch, dropout_key
+                )[0]
+
+            updates, new_opt = optimizer.update(
+                grads,
+                state.opt_state,
+                state.params,
+                value=loss,
+                grad=grads,
+                value_fn=value_fn,
+            )
+        else:
+            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(
             lambda p, u: p + u, state.params, updates
         )
@@ -228,6 +249,15 @@ def make_train_step_dp(
     (replicated node contributions stay unscaled, edge-shard contributions sum)."""
     from jax.experimental.shard_map import shard_map
 
+    from ..utils.optimizer import ValueFnTransformation
+
+    if isinstance(optimizer, ValueFnTransformation):
+        raise NotImplementedError(
+            "LBFGS is not supported in the distributed (mesh) train step: the "
+            "zoom linesearch would evaluate per-shard losses and diverge "
+            "across devices. Use a first-order optimizer (AdamW) for "
+            "distributed runs, or LBFGS on a single device."
+        )
     graph_sharded = model.graph_axis is not None and mesh.shape.get("graph", 1) > 1
     grad_axes = ("data", "graph") if graph_sharded else ("data",)
 

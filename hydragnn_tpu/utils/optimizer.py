@@ -13,6 +13,12 @@ from typing import Optional
 import optax
 
 
+class ValueFnTransformation(optax.GradientTransformationExtraArgs):
+    """Marker type: ``update()`` needs ``(value, grad, value_fn)`` threaded
+    through by the train step (optax's zoom linesearch contract). The step
+    builders in train/trainer.py check for this type."""
+
+
 def _base_optimizer(name: str, learning_rate: float):
     name_l = name.lower()
     table = {
@@ -27,11 +33,6 @@ def _base_optimizer(name: str, learning_rate: float):
         "rmsprop": lambda lr: optax.rmsprop(lr),
         # torch SparseAdam is Adam with sparse-gradient support; dense here.
         "sparseadam": lambda lr: optax.adam(lr),
-        # linesearch=None: the zoom linesearch needs (value, grad, value_fn)
-        # threaded through update(), which the generic train step doesn't do;
-        # plain limited-memory direction scaled by lr instead. The reference
-        # never ships an LBFGS config (all use AdamW).
-        "lbfgs": lambda lr: optax.lbfgs(lr, linesearch=None),
     }
     if name_l not in table:
         raise ValueError(f"Purpose of {name} optimizer is not defined.")
@@ -43,6 +44,20 @@ def select_optimizer(
     learning_rate: float,
     freeze_conv: bool = False,
 ) -> optax.GradientTransformation:
+    if name.lower() == "lbfgs":
+        # Real LBFGS (torch parity, reference optimizer.py:19-20): limited
+        # memory + zoom linesearch choosing the step size, so the injected LR
+        # is not a knob (get_learning_rate returns None; the plateau
+        # scheduler skips it). The train step threads value/grad/value_fn
+        # through update() — single-device/scan paths only.
+        opt = optax.lbfgs()
+        if freeze_conv:
+            raise NotImplementedError(
+                "freeze_conv_layers with LBFGS is not supported: the "
+                "linesearch evaluates the full loss, which conflicts with "
+                "masked zero updates."
+            )
+        return ValueFnTransformation(opt.init, opt.update)
     _base_optimizer(name, learning_rate)  # eager name validation
     opt = optax.inject_hyperparams(
         lambda learning_rate: _base_optimizer(name, learning_rate)
@@ -96,28 +111,65 @@ def set_learning_rate(opt_state, lr: float):
 
 
 class ReduceLROnPlateau:
-    """Host-side plateau scheduler (reference run_training.py:82-84: factor 0.5,
-    patience 5, min_lr 1e-5; stepped on validation RMSE every epoch)."""
+    """Host-side plateau scheduler with torch's exact decision semantics
+    (torch.optim.lr_scheduler.ReduceLROnPlateau is what the reference
+    configures, run_training.py:82-84: factor 0.5, patience 5, min_lr 1e-5;
+    stepped on validation RMSE every epoch). Matches torch's defaults for the
+    parts that change behavior on noisy curves: relative improvement
+    threshold (1e-4) and a post-reduction cooldown (0) — verified against
+    torch's decision trace in tests/test_optimizers.py."""
 
-    def __init__(self, factor=0.5, patience=5, min_lr=1e-5, mode="min"):
+    def __init__(
+        self,
+        factor=0.5,
+        patience=5,
+        min_lr=1e-5,
+        mode="min",
+        threshold=1e-4,
+        threshold_mode="rel",
+        cooldown=0,
+    ):
         self.factor = factor
         self.patience = patience
         self.min_lr = min_lr
         self.mode = mode
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
         self.best = None
         self.num_bad_epochs = 0
+        self.cooldown_counter = 0
+
+    def _is_better(self, metric: float) -> bool:
+        if self.best is None:
+            return True
+        if self.threshold_mode == "rel":
+            eps = (
+                1.0 - self.threshold
+                if self.mode == "min"
+                else 1.0 + self.threshold
+            )
+            bar = self.best * eps
+        else:  # "abs"
+            bar = (
+                self.best - self.threshold
+                if self.mode == "min"
+                else self.best + self.threshold
+            )
+        return metric < bar if self.mode == "min" else metric > bar
 
     def step(self, metric: float, current_lr: float) -> float:
         """Returns the (possibly reduced) learning rate."""
-        better = self.best is None or (
-            metric < self.best if self.mode == "min" else metric > self.best
-        )
-        if better:
+        if self._is_better(metric):
             self.best = metric
             self.num_bad_epochs = 0
         else:
             self.num_bad_epochs += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad_epochs = 0
         if self.num_bad_epochs > self.patience:
             self.num_bad_epochs = 0
+            self.cooldown_counter = self.cooldown
             return max(current_lr * self.factor, self.min_lr)
         return current_lr

@@ -108,3 +108,76 @@ def pytest_plateau_scheduler_and_lr_injection():
 
     new_state = set_learning_rate(state.opt_state, lr)
     assert get_learning_rate(new_state) == pytest.approx(5e-3)
+
+
+def pytest_plateau_matches_torch_decision_trace():
+    """Decision-trace parity with torch.optim.lr_scheduler.ReduceLROnPlateau
+    (what the reference configures, run_training.py:82-84) on a noisy recorded
+    validation curve — exercises the relative threshold (tiny improvements
+    still count as bad epochs) and cooldown (bad-epoch counting pauses after
+    a reduction)."""
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.default_rng(3)
+    base = np.linspace(1.0, 0.8, 40)
+    metrics = (base + rng.normal(0, 5e-5, 40)).tolist()  # sub-threshold noise
+    metrics += [0.79999, 0.79998, 0.79997] * 5  # tiny "improvements"
+
+    for kwargs in (
+        dict(factor=0.5, patience=3, cooldown=0),
+        dict(factor=0.5, patience=2, cooldown=4),
+        dict(factor=0.1, patience=1, cooldown=2, threshold=1e-2),
+    ):
+        opt = torch.optim.SGD([torch.nn.Parameter(torch.zeros(1))], lr=0.1)
+        ref = torch.optim.lr_scheduler.ReduceLROnPlateau(
+            opt, mode="min", min_lr=1e-5, **kwargs
+        )
+        mine = ReduceLROnPlateau(min_lr=1e-5, **kwargs)
+        lr = 0.1
+        for m in metrics:
+            ref.step(m)
+            lr = mine.step(m, lr)
+            assert lr == pytest.approx(opt.param_groups[0]["lr"]), (
+                kwargs,
+                m,
+            )
+
+
+def pytest_lbfgs_linesearch_converges():
+    """LBFGS with the zoom linesearch (value/grad/value_fn threaded through
+    the train step — torch-LBFGS parity, reference optimizer.py:19-20) must
+    crush a small deterministic fit far faster than a fixed-LR first-order
+    step, and must refuse the distributed step builder."""
+    from hydragnn_tpu.train.trainer import make_train_step
+
+    rng = np.random.default_rng(1)
+    model, batch, _ = _setup(rng)
+    variables = init_model_variables(model, batch)
+    opt = select_optimizer("LBFGS", 1.0)
+    state = create_train_state(model, variables, opt)
+    step = make_train_step(model, opt, donate=state_donation_safe(state))
+    key = jax.random.PRNGKey(0)
+    first = None
+    for _ in range(25):
+        state, m = step(state, batch, key)
+        loss = float(m["loss"]) / max(float(m["count"]), 1.0)
+        first = loss if first is None else first
+    assert np.isfinite(loss)
+    assert loss < first * 0.2, (first, loss)
+
+
+def pytest_lbfgs_rejected_in_distributed_step():
+    from hydragnn_tpu.parallel import make_mesh
+    from hydragnn_tpu.train.trainer import make_train_step_dp
+
+    rng = np.random.default_rng(1)
+    model, batch, _ = _setup(rng)
+    opt = select_optimizer("LBFGS", 1.0)
+    mesh = make_mesh(data_axis=2)
+    with pytest.raises(NotImplementedError, match="LBFGS"):
+        make_train_step_dp(model, opt, mesh)
+
+
+def pytest_lbfgs_freeze_conv_rejected():
+    with pytest.raises(NotImplementedError, match="freeze_conv"):
+        select_optimizer("LBFGS", 1.0, freeze_conv=True)
