@@ -250,6 +250,26 @@ def main():
             result["value"] / BASELINE_GRAPHS_PER_SEC, 3
         )
         result.update(_production_workload())
+        if jax.default_backend() == "tpu":
+            # Re-certify the fused Pallas kernel on every benchmark run:
+            # forward/grad accuracy vs f64 ground truth + measured speedup
+            # over the XLA segment bundle. Non-fatal — a certification
+            # failure is reported, not allowed to redden the whole bench.
+            try:
+                from hydragnn_tpu.ops.pallas_segment import certify_pallas
+
+                cert = certify_pallas()
+                result["pallas_ok"] = cert["ok"]
+                result["pallas_speedup"] = cert["speedup"]
+                # Whether the benchmarked workload itself used the kernel
+                # (HYDRAGNN_PALLAS=0 would certify a kernel production skips).
+                result["pallas_enabled"] = cert["pallas_enabled"]
+                result["pallas_max_err"] = max(
+                    cert["max_err_fwd"], cert["max_err_grad"]
+                )
+            except Exception as e:
+                result["pallas_ok"] = False
+                result["pallas_error"] = f"{type(e).__name__}: {e}"
     except Exception as e:  # diagnostic JSON instead of a bare traceback
         import traceback
 

@@ -14,7 +14,10 @@ os.environ["XLA_FLAGS"] = (
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# HYDRAGNN_TPU_TESTS=1 leaves the real accelerator as the default backend so
+# the TPU-gated suites (tests/test_pallas_tpu.py) run on hardware.
+if os.environ.get("HYDRAGNN_TPU_TESTS") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_collection_modifyitems(config, items):

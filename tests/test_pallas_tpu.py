@@ -1,0 +1,36 @@
+"""On-hardware certification of the fused Pallas segment kernel — skipped off
+TPU (the normal suite pins CPU; run with HYDRAGNN_TPU_TESTS=1 to enable).
+Asserts the compiled kernel's forward and gradient match the XLA segment ops
+on the real chip and logs the measured speedup of the sum/mean/std bundle
+(the PNA aggregation hot path, reference PNAStack.py:28-53). bench.py runs
+the same certification on every benchmark invocation."""
+
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hydragnn_tpu.ops.pallas_segment import certify_pallas
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="requires a real TPU (set HYDRAGNN_TPU_TESTS=1)",
+)
+
+
+def pytest_fused_kernel_certified_on_tpu():
+    report = certify_pallas()
+    print(f"pallas certification: {report}")
+    assert report["pallas_enabled"], "Pallas gate off on TPU backend"
+    # f32-class accuracy vs the f64 ground truth (bf16 hi/lo split forward,
+    # analytic centered backward) — tolerance owned by certify_pallas — and
+    # at least as accurate as XLA's bundle, whose uncentered std gradient
+    # cancels catastrophically.
+    assert report["ok"], report
+    assert report["max_err_grad"] <= report["xla_err_grad"] * 2, report
+    assert report["speedup"] > 1.0, (
+        f"fused kernel slower than XLA bundle: {report}"
+    )
