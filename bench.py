@@ -232,6 +232,23 @@ def _production_workload():
     }
 
 
+def _transient(e: Exception) -> bool:
+    """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
+    'remote_compile: Connection refused') — retryable; real failures are not."""
+    msg = f"{type(e).__name__}: {e}"
+    return "UNAVAILABLE" in msg or "Connection refused" in msg
+
+
+def _with_retries(fn, attempts=3, backoff_s=60.0):
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            if i == attempts - 1 or not _transient(e):
+                raise
+            time.sleep(backoff_s * (i + 1))
+
+
 def main():
     result = {
         "metric": "train_throughput_pna_multitask",
@@ -244,12 +261,12 @@ def main():
 
         result["backend"] = jax.default_backend()
         result["device_kind"] = jax.devices()[0].device_kind
-        result.update(_peak_workload())
+        result.update(_with_retries(_peak_workload))
         result.pop("flops_per_step", None)  # internal to the MFU computation
         result["vs_baseline"] = round(
             result["value"] / BASELINE_GRAPHS_PER_SEC, 3
         )
-        result.update(_production_workload())
+        result.update(_with_retries(_production_workload))
         if jax.default_backend() == "tpu":
             # Re-certify the fused Pallas kernel on every benchmark run:
             # forward/grad accuracy vs f64 ground truth + measured speedup
@@ -258,7 +275,7 @@ def main():
             try:
                 from hydragnn_tpu.ops.pallas_segment import certify_pallas
 
-                cert = certify_pallas()
+                cert = _with_retries(certify_pallas)
                 result["pallas_ok"] = cert["ok"]
                 result["pallas_speedup"] = cert["speedup"]
                 # Whether the benchmarked workload itself used the kernel

@@ -1,7 +1,11 @@
 from .distributed import (
     barrier,
     get_comm_size_and_rank,
+    get_local_rank,
+    get_local_size,
     init_comm_size_and_rank,
     make_mesh,
+    parse_slurm_nodelist,
+    resolve_coordinator_address,
     setup_ddp,
 )

@@ -31,6 +31,143 @@ def init_comm_size_and_rank() -> Tuple[int, int]:
     return world_size, world_rank
 
 
+def parse_slurm_nodelist(nodelist: str) -> list:
+    """Expand a SLURM compressed hostlist into individual node names — the
+    rendezvous-address source on SLURM clusters (reference
+    /root/reference/hydragnn/utils/distributed.py:43-74, used at :126-132).
+
+    Handles single nodes, bracketed groups, zero-padded ranges, and multiple
+    comma-separated blocks: ``"gpu-a,node[01,03-05]"`` →
+    ``["gpu-a", "node01", "node03", "node04", "node05"]``.
+    """
+    # Split on commas OUTSIDE brackets only.
+    blocks, depth, cur = [], 0, []
+    for ch in nodelist:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            blocks.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        blocks.append("".join(cur))
+
+    nodes = []
+    for block in blocks:
+        block = block.strip()
+        if block:
+            nodes.extend(_expand_hostlist_block(block))
+    return nodes
+
+
+def _expand_hostlist_block(block: str) -> list:
+    """Expand ONE hostlist block, recursing past the first bracket group so
+    multi-dimension names ("rack[1-2]n[1-4]") and suffixes ("tux[1-2]-ib")
+    expand instead of crashing."""
+    i = block.find("[")
+    if i < 0:
+        return [block]
+    j = block.index("]", i)
+    prefix, group, rest = block[:i], block[i + 1 : j], block[j + 1 :]
+    tails = _expand_hostlist_block(rest) if rest else [""]
+    out = []
+    for item in group.split(","):
+        lo, _, hi = item.partition("-")
+        if hi:
+            width = len(lo)
+            mids = [f"{k:0{width}d}" for k in range(int(lo), int(hi) + 1)]
+        else:
+            mids = [item]
+        out.extend(prefix + mid + tail for mid in mids for tail in tails)
+    return out
+
+
+def resolve_coordinator_address() -> str:
+    """Coordinator (rendezvous master) address, resolved the way the reference
+    picks MASTER_ADDR (distributed.py:120-132): explicit env wins, then the
+    LSF batch hostlist (first compute host — LSB_HOSTS[0] is the batch node),
+    then the first SLURM node, else localhost. Port from MASTER_PORT or the
+    reference's default 8889."""
+    addr = os.getenv("MASTER_ADDR")
+    if not addr and os.getenv("LSB_HOSTS"):
+        hosts = os.environ["LSB_HOSTS"].split()
+        addr = hosts[1] if len(hosts) > 1 else hosts[0]
+    if not addr and os.getenv("SLURM_NODELIST"):
+        nodes = parse_slurm_nodelist(os.environ["SLURM_NODELIST"])
+        addr = nodes[0] if nodes else None
+    if not addr:
+        addr = "127.0.0.1"
+    return f"{addr}:{os.getenv('MASTER_PORT', '8889')}"
+
+
+def get_local_rank() -> int:
+    """Process index within its host (reference local-rank selection,
+    distributed.py:181-189) — picks this process's slot among the host's local
+    devices in multi-process-per-host launches."""
+    fam = _local_family()
+    if fam is not None:  # a complete rank+size family wins over a lone var
+        return fam[0]
+    for var in ("OMPI_COMM_WORLD_LOCAL_RANK", "SLURM_LOCALID"):
+        if os.getenv(var):
+            return int(os.environ[var])
+    return 0
+
+
+def _tasks_per_node_counts(val: str) -> list:
+    """Per-node task counts from SLURM_NTASKS_PER_NODE's compressed grammar:
+    "4" → [4]; "4(x2)" → [4, 4]; "4(x2),3" → [4, 4, 3] (heterogeneous)."""
+    counts = []
+    for part in val.split(","):
+        n, _, rep = part.partition("(x")
+        counts.extend([int(n)] * (int(rep.rstrip(")")) if rep else 1))
+    return counts
+
+
+def _local_family():
+    """(local_rank, max tasks-per-node) read from ONE launcher family — mixing
+    (e.g. SLURM size with an OMPI rank) silently misplaces processes. None if
+    no family is fully present or its size grammar doesn't parse."""
+    for rank_var, size_var in (
+        ("OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE"),
+        ("SLURM_LOCALID", "SLURM_NTASKS_PER_NODE"),
+    ):
+        if os.getenv(rank_var) and os.getenv(size_var):
+            try:
+                counts = _tasks_per_node_counts(os.environ[size_var])
+                return int(os.environ[rank_var]), max(counts)
+            except ValueError:
+                return None
+    return None
+
+
+def get_local_size() -> int:
+    """Processes launched per host — the max over nodes on heterogeneous
+    allocations (1 when the scheduler doesn't say or the value is garbled)."""
+    fam = _local_family()
+    if fam is not None:
+        return fam[1]
+    for var in ("OMPI_COMM_WORLD_LOCAL_SIZE", "SLURM_NTASKS_PER_NODE"):
+        if os.getenv(var):
+            try:
+                return max(_tasks_per_node_counts(os.environ[var]))
+            except ValueError:
+                return 1
+    return 1
+
+
+def _local_device_slot():
+    """Local-device slot for this process, or None for JAX's default (claim
+    all local devices). Slot mode only when the launcher says several
+    processes share a host (local rank > 0 is itself proof)."""
+    fam = _local_family()
+    if fam is not None and (fam[0] > 0 or fam[1] > 1):
+        return fam[0]
+    return None
+
+
 def _distributed_active() -> bool:
     """Whether jax.distributed.initialize already ran — checked WITHOUT
     touching jax.process_count(), which would initialize the XLA backend and
@@ -54,13 +191,19 @@ def setup_ddp(coordinator_address: Optional[str] = None) -> Tuple[int, int]:
     if world_size > 1 and not _distributed_active():
         try:
             if coordinator_address is None:
-                master_addr = os.getenv("MASTER_ADDR", "127.0.0.1")
-                master_port = os.getenv("MASTER_PORT", "8889")
-                coordinator_address = f"{master_addr}:{master_port}"
+                coordinator_address = resolve_coordinator_address()
+            kwargs = {}
+            slot = _local_device_slot()
+            if slot is not None:
+                # Reference 1-rank-per-device placement (distributed.py:
+                # 181-189): with several processes per host each claims its
+                # own local-device slot instead of all of them.
+                kwargs["local_device_ids"] = [slot]
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=world_size,
                 process_id=world_rank,
+                **kwargs,
             )
         except Exception as e:  # sequential fallback (distributed.py:155-157)
             print(f"Fall back to sequential execution mode: {e}")

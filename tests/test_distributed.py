@@ -140,3 +140,111 @@ def pytest_dp_training_runs_and_reduces():
     em, outputs = eval_step(state2, batch)
     assert float(em["count"]) == 40.0
     assert outputs[0].shape[0] == 8  # leading device axis restored
+
+
+def pytest_slurm_nodelist_parser():
+    """Scheduler-hostlist expansion parity (reference parse_slurm_nodelist,
+    /root/reference/hydragnn/utils/distributed.py:43-74)."""
+    from hydragnn_tpu.parallel import parse_slurm_nodelist
+
+    assert parse_slurm_nodelist("or-condo-g04") == ["or-condo-g04"]
+    assert parse_slurm_nodelist("or-condo-g[05,07-08,13]") == [
+        "or-condo-g05", "or-condo-g07", "or-condo-g08", "or-condo-g13",
+    ]
+    assert parse_slurm_nodelist("or-condo-g[05,07-08,13],or-condo-h[01,12]") == [
+        "or-condo-g05", "or-condo-g07", "or-condo-g08", "or-condo-g13",
+        "or-condo-h01", "or-condo-h12",
+    ]
+    # zero-padded widths preserved
+    assert parse_slurm_nodelist("n[008-011]") == ["n008", "n009", "n010", "n011"]
+
+
+def pytest_coordinator_address_resolution(monkeypatch):
+    """MASTER_ADDR > LSB_HOSTS > SLURM_NODELIST > localhost (reference
+    distributed.py:120-132), port from MASTER_PORT (default 8889)."""
+    from hydragnn_tpu.parallel import get_local_rank, resolve_coordinator_address
+
+    for var in ("MASTER_ADDR", "MASTER_PORT", "LSB_HOSTS", "SLURM_NODELIST"):
+        monkeypatch.delenv(var, raising=False)
+    assert resolve_coordinator_address() == "127.0.0.1:8889"
+
+    monkeypatch.setenv("SLURM_NODELIST", "cades-a[02-03]")
+    assert resolve_coordinator_address() == "cades-a02:8889"
+
+    # LSF: first entry is the batch node; rendezvous on the first compute host.
+    monkeypatch.setenv("LSB_HOSTS", "batch01 h41n03 h41n04")
+    assert resolve_coordinator_address() == "h41n03:8889"
+
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.7")
+    monkeypatch.setenv("MASTER_PORT", "7777")
+    assert resolve_coordinator_address() == "10.0.0.7:7777"
+
+    monkeypatch.delenv("OMPI_COMM_WORLD_LOCAL_RANK", raising=False)
+    monkeypatch.setenv("SLURM_LOCALID", "3")
+    assert get_local_rank() == 3
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+    assert get_local_rank() == 1
+
+
+def pytest_local_size_detection(monkeypatch):
+    from hydragnn_tpu.parallel import get_local_size
+
+    for var in ("OMPI_COMM_WORLD_LOCAL_SIZE", "SLURM_NTASKS_PER_NODE"):
+        monkeypatch.delenv(var, raising=False)
+    assert get_local_size() == 1
+    monkeypatch.setenv("SLURM_NTASKS_PER_NODE", "4(x2)")
+    assert get_local_size() == 4
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
+    assert get_local_size() == 2
+
+
+def pytest_local_device_slot_same_family(monkeypatch):
+    """local_device_ids placement must derive rank+size from ONE launcher
+    family; a partial env (rank without size, or vice versa) means default
+    claim-all placement."""
+    from hydragnn_tpu.parallel.distributed import _local_device_slot
+
+    for var in (
+        "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE",
+        "SLURM_LOCALID", "SLURM_NTASKS_PER_NODE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    assert _local_device_slot() is None
+    monkeypatch.setenv("SLURM_LOCALID", "2")  # rank without size: default
+    assert _local_device_slot() is None
+    monkeypatch.setenv("SLURM_NTASKS_PER_NODE", "4(x2)")
+    assert _local_device_slot() == 2
+    monkeypatch.setenv("SLURM_LOCALID", "0")
+    monkeypatch.setenv("SLURM_NTASKS_PER_NODE", "1")  # 1 proc/host: default
+    assert _local_device_slot() is None
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "0")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
+    assert _local_device_slot() == 0
+
+
+def pytest_hostlist_and_tasks_grammar(monkeypatch):
+    """Hard SLURM grammar: multi-bracket names, suffixes, heterogeneous
+    tasks-per-node lists — must parse, never crash into sequential fallback."""
+    from hydragnn_tpu.parallel import parse_slurm_nodelist
+    from hydragnn_tpu.parallel.distributed import (
+        _local_device_slot,
+        _tasks_per_node_counts,
+    )
+
+    assert parse_slurm_nodelist("rack[1-2]n[1-2]") == [
+        "rack1n1", "rack1n2", "rack2n1", "rack2n2",
+    ]
+    assert parse_slurm_nodelist("tux[1-2]-ib") == ["tux1-ib", "tux2-ib"]
+    assert _tasks_per_node_counts("4(x2),3") == [4, 4, 3]
+    assert _tasks_per_node_counts("4,2") == [4, 2]
+
+    for var in (
+        "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE",
+        "SLURM_LOCALID", "SLURM_NTASKS_PER_NODE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("SLURM_LOCALID", "1")
+    monkeypatch.setenv("SLURM_NTASKS_PER_NODE", "4(x2),3")
+    assert _local_device_slot() == 1
+    monkeypatch.setenv("SLURM_NTASKS_PER_NODE", "garbled")
+    assert _local_device_slot() is None  # unparseable → default placement
