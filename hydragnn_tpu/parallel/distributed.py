@@ -205,9 +205,18 @@ def setup_ddp(coordinator_address: Optional[str] = None) -> Tuple[int, int]:
                 process_id=world_rank,
                 **kwargs,
             )
-        except Exception as e:  # sequential fallback (distributed.py:155-157)
-            print(f"Fall back to sequential execution mode: {e}")
-            return 1, 0
+        except Exception as e:
+            # DIVERGENCE from the reference's silent sequential fallback
+            # (distributed.py:155-157): once the scheduler env promised
+            # world_size > 1, peers are already connecting to the coordinator
+            # — one rank quietly going sequential leaves the rest blocked at
+            # rendezvous until timeout. Fail loudly instead.
+            raise RuntimeError(
+                f"jax.distributed.initialize failed for rank {world_rank}/"
+                f"{world_size} at {coordinator_address}: {e}. Check the "
+                "rendezvous env (MASTER_ADDR/LSB_HOSTS/SLURM_NODELIST) and "
+                "that the local device slot exists on this host."
+            ) from e
     return get_comm_size_and_rank()
 
 

@@ -19,7 +19,9 @@ from .train.train_validate_test import TrainingDriver, train_validate_test
 from .train.trainer import create_train_state
 from .utils.config_utils import get_log_name_config, update_config
 from .utils.model import (
+    checkpoint_exists,
     get_summary_writer,
+    load_existing_model,
     load_existing_model_config,
     save_model,
 )
@@ -92,6 +94,46 @@ def _(config: dict, mesh=None):
         opt_state=opt_state,
     )
 
+    # Crash resume (Training.resume — extension over the reference, which only
+    # warm-starts weights and replays all epochs, SURVEY.md §5.3/5.4): pick up
+    # THIS run's own checkpoint at the exact epoch/scheduler/history it saved.
+    start_epoch = 0
+    prior_history = None
+    if config["NeuralNetwork"]["Training"].get("resume"):
+        have = checkpoint_exists(log_name)
+        if world_size > 1:
+            # Every process replays the same epoch range — a rank resuming
+            # while others start fresh would deadlock at the first mismatched
+            # collective. Agree on the checkpoint's visibility up front.
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(np.int32(have))
+            if int(flags.min()) != int(flags.max()):
+                raise RuntimeError(
+                    "Training.resume: checkpoint for "
+                    f"{log_name} is visible on some hosts but not others — "
+                    "multi-host resume requires ./logs on shared storage"
+                )
+        if have:
+            new_vars, opt_state, meta = load_existing_model(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                log_name,
+                opt_state=state.opt_state,
+                return_meta=True,
+            )
+            state = state.replace(
+                params=new_vars["params"],
+                batch_stats=new_vars["batch_stats"],
+                opt_state=opt_state,
+            )
+            start_epoch = int(meta.get("epoch", 0))
+            if meta.get("scheduler"):
+                scheduler.load_state_dict(meta["scheduler"])
+            prior_history = meta.get("history")
+            print_distributed(
+                verbosity, f"Resuming {log_name} from epoch {start_epoch}"
+            )
+
     print_distributed(
         verbosity,
         "Starting training with the configuration: \n"
@@ -145,6 +187,8 @@ def _(config: dict, mesh=None):
         checkpoint_every=config["NeuralNetwork"]["Training"].get(
             "periodic_checkpoint_every", 0
         ),
+        start_epoch=start_epoch,
+        history=prior_history,
     )
 
     if viz is not None:
@@ -171,6 +215,11 @@ def _(config: dict, mesh=None):
         {"params": driver.state.params, "batch_stats": driver.state.batch_stats},
         driver.state.opt_state,
         log_name,
+        meta={
+            "epoch": config["NeuralNetwork"]["Training"]["num_epoch"],
+            "scheduler": scheduler.state_dict(),
+            "history": history,
+        },
     )
     print_timers(verbosity)
     return history

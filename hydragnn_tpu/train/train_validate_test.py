@@ -332,6 +332,8 @@ def train_validate_test(
     plot_hist_solution: bool = False,
     checkpoint_name: Optional[str] = None,
     checkpoint_every: int = 0,
+    start_epoch: int = 0,
+    history: Optional[dict] = None,
 ):
     """The epoch loop (train_validate_test.py:94-137). Returns the loss history
     dict consumed by the Visualizer. With a visualizer attached, mirrors the
@@ -345,7 +347,7 @@ def train_validate_test(
             visualizer.create_scatter_plots(
                 tv, pv, output_names=output_names, iepoch=-1
             )
-    history = {
+    history = history or {
         "total_loss_train": [],
         "total_loss_val": [],
         "total_loss_test": [],
@@ -355,7 +357,7 @@ def train_validate_test(
     }
     timer = Timer("train_validate_test")
     timer.start()
-    for epoch in range(num_epoch):
+    for epoch in range(start_epoch, num_epoch):
         for loader in (train_loader, val_loader, test_loader):
             if hasattr(loader, "set_epoch"):
                 loader.set_epoch(epoch)
@@ -425,6 +427,11 @@ def train_validate_test(
                 },
                 driver.state.opt_state,
                 checkpoint_name,
+                meta={
+                    "epoch": epoch + 1,
+                    "scheduler": scheduler.state_dict() if scheduler else None,
+                    "history": history,
+                },
             )
     if profiler:
         profiler.stop()

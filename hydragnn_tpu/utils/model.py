@@ -31,8 +31,11 @@ def save_model(
     opt_state: Any,
     name: str,
     path: str = "./logs/",
+    meta: Optional[Dict[str, Any]] = None,
 ) -> None:
-    """Rank-0 single-file checkpoint (model.py:35-47)."""
+    """Rank-0 single-file checkpoint (model.py:35-47). ``meta`` carries
+    training progress (epoch, scheduler state, loss history) so a preempted
+    run can resume exactly where it stopped (Training.resume)."""
     if not _is_rank_zero():
         return
     path_name = os.path.join(path, name, name + ".pk")
@@ -43,6 +46,8 @@ def save_model(
         if opt_state is not None
         else None,
     }
+    if meta is not None:
+        payload["meta"] = meta
     os.makedirs(os.path.dirname(path_name), exist_ok=True)
     # Atomic write: a crash mid-dump must not leave a torn checkpoint that a
     # later warm start would fail on.
@@ -57,9 +62,11 @@ def load_existing_model(
     model_name: str,
     path: str = "./logs/",
     opt_state: Any = None,
+    return_meta: bool = False,
 ):
     """Restore params/batch_stats (+optimizer state if given a template) from the
-    single-file checkpoint (model.py:63-78). Returns (variables, opt_state)."""
+    single-file checkpoint (model.py:63-78). Returns (variables, opt_state), plus
+    the progress meta dict when ``return_meta`` (one file read, not two)."""
     path_name = os.path.join(path, model_name, model_name + ".pk")
     with open(path_name, "rb") as f:
         payload = pickle.load(f)
@@ -72,6 +79,8 @@ def load_existing_model(
     new_vars["batch_stats"] = bstats
     if opt_state is not None and payload.get("opt_state") is not None:
         opt_state = serialization.from_bytes(opt_state, payload["opt_state"])
+    if return_meta:
+        return new_vars, opt_state, payload.get("meta") or {}
     return new_vars, opt_state
 
 
@@ -87,6 +96,15 @@ def load_existing_model_config(
 
 def checkpoint_exists(model_name: str, path: str = "./logs/") -> bool:
     return os.path.exists(os.path.join(path, model_name, model_name + ".pk"))
+
+
+def load_checkpoint_meta(model_name: str, path: str = "./logs/") -> Dict[str, Any]:
+    """Training-progress metadata stored alongside the weights ({} for
+    checkpoints written before meta existed, or when none was saved)."""
+    path_name = os.path.join(path, model_name, model_name + ".pk")
+    with open(path_name, "rb") as f:
+        payload = pickle.load(f)
+    return payload.get("meta") or {}
 
 
 def get_summary_writer(name: str, path: str = "./logs/"):

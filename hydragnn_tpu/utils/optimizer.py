@@ -173,3 +173,17 @@ class ReduceLROnPlateau:
             self.cooldown_counter = self.cooldown
             return max(current_lr * self.factor, self.min_lr)
         return current_lr
+
+    def state_dict(self) -> dict:
+        """Mutable decision state (for checkpoint resume); hyperparameters are
+        reconstructed from config, matching torch's state_dict split."""
+        return {
+            "best": self.best,
+            "num_bad_epochs": self.num_bad_epochs,
+            "cooldown_counter": self.cooldown_counter,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.best = state["best"]
+        self.num_bad_epochs = int(state["num_bad_epochs"])
+        self.cooldown_counter = int(state["cooldown_counter"])
