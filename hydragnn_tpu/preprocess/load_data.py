@@ -43,14 +43,28 @@ def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1):
     Documented divergence: the reference shuffles val/test too
     (load_data.py:75-84), which silently misaligns its Visualizer's
     dataset-order node features with eval-order predictions. Eval loaders
-    here keep dataset order — shuffling eval batches has no training effect."""
+    here keep dataset order — shuffling eval batches has no training effect.
+
+    Documented divergence: ``batch_size`` is the GLOBAL batch — each process
+    takes batch_size/world_size graphs per step, so the optimizer trajectory
+    (steps per epoch, gradient noise scale) is invariant under the process
+    count. The reference's batch_size is per-rank (DistributedSampler halves
+    steps and doubles the effective batch at 2 ranks), which shifts
+    convergence for the same config as ranks change."""
     world_size, rank = get_comm_size_and_rank()
+    shard_batch = max(1, -(-batch_size // world_size))
+    if shard_batch * world_size != batch_size:
+        print(
+            f"WARNING: batch_size {batch_size} is not divisible by "
+            f"{world_size} processes; using {shard_batch}/process "
+            f"(effective global batch {shard_batch * world_size})"
+        )
     loaders = []
     for ds, shuffle in ((trainset, True), (valset, False), (testset, False)):
         loaders.append(
             GraphDataLoader(
                 ds,
-                batch_size=batch_size,
+                batch_size=shard_batch,
                 shuffle=shuffle,
                 num_shards=world_size,
                 shard_rank=rank,

@@ -35,7 +35,13 @@ def _(config_file: str, mesh=None):
 @run_prediction.register
 def _(config: dict, mesh=None):
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
-    setup_ddp()
+    world_size, _rank = setup_ddp()
+    if mesh is None and world_size > 1:
+        # Same auto data-parallel rule as run_training: multi-process launches
+        # evaluate through the global data mesh.
+        from .parallel.distributed import make_mesh
+
+        mesh = make_mesh()
 
     train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
         config=config

@@ -47,8 +47,19 @@ def _(config_file: str, mesh=None):
 def _(config: dict, mesh=None):
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
 
-    setup_log(get_log_name_config(config))
+    # Bootstrap BEFORE anything touches jax (setup_log rank-prefixes via
+    # jax.process_index(), which initializes the XLA backend —
+    # jax.distributed.initialize must run first).
     world_size, world_rank = setup_ddp()
+    setup_log(get_log_name_config(config))
+    if mesh is None and world_size > 1:
+        # Reference semantics: training is data-parallel whenever the process
+        # group is initialized (DDP wrap, reference run_training.py:78 +
+        # distributed.py:216-226) — a multi-process launch without an explicit
+        # mesh gets the global data mesh automatically.
+        from .parallel.distributed import make_mesh
+
+        mesh = make_mesh()
 
     verbosity = config["Verbosity"]["level"]
     train_loader, val_loader, test_loader, sampler_list = (
@@ -221,5 +232,8 @@ def _(config: dict, mesh=None):
             "history": history,
         },
     )
+    # Non-zero ranks must not race ahead into a checkpoint load (e.g.
+    # run_prediction immediately after training) while rank 0 is still writing.
+    barrier("final_checkpoint")
     print_timers(verbosity)
     return history

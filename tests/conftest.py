@@ -9,7 +9,9 @@ with 2 MPI ranks (/root/reference/.github/workflows/CI.yml:47-52).
 import os
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("HYDRAGNN_HOST_DEVICES", "8")
 )
 
 import jax
@@ -39,3 +41,19 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "mpi_skip" in item.keywords:
             item.add_marker(skip)
+
+    # DIVERGENCE from the reference's mpirun model (where every CPU unit test
+    # harmlessly runs twice): JAX's runtime is process-global — once
+    # jax.distributed initializes, jax.devices() is the GLOBAL device set, so
+    # unit tests that build their own single-process virtual meshes are
+    # inherently serial. Under a multi-process launch only the world-agnostic
+    # end-to-end suites run (the high-level API auto-shards over the global
+    # mesh); distributed unit coverage lives in tests/test_distributed.py and
+    # the rendezvous harness in tests/test_multiprocess.py.
+    world_safe = {"test_graphs.py"}
+    skip_local = pytest.mark.skip(
+        reason="single-process test (local virtual mesh) under multi-process run"
+    )
+    for item in items:
+        if os.path.basename(str(item.fspath)) not in world_safe:
+            item.add_marker(skip_local)

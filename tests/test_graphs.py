@@ -59,23 +59,44 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
     if use_lengths:
         config["NeuralNetwork"]["Architecture"]["edge_features"] = ["lengths"]
 
-    # Generate raw text datasets if needed.
+    # Generate raw text datasets if needed. Rank 0 generates; other ranks of a
+    # multi-process run (the mpirun -n 2 CI analog) wait on a sibling sentinel
+    # so shared fixture files are never written concurrently.
     num_samples_tot = 500
     pkl_input = list(config["Dataset"]["path"].values())[0].endswith(".pkl")
     if not pkl_input:
+        import time as _time
+
+        from hydragnn_tpu.parallel.distributed import init_comm_size_and_rank
+
+        _, world_rank = init_comm_size_and_rank()
         perc_train = config["NeuralNetwork"]["Training"]["perc_train"]
+        # Per-launch nonce (MASTER_PORT is shared by all ranks of one launch,
+        # unique per launch) so a stale sentinel from an earlier run can't
+        # release waiting ranks early.
+        run_id = os.environ.get("MASTER_PORT", "serial")
         for dataset_name, data_path in config["Dataset"]["path"].items():
-            num_samples = {
-                "total": num_samples_tot,
-                "train": int(num_samples_tot * perc_train),
-                "test": int(num_samples_tot * (1 - perc_train) * 0.5),
-                "validate": int(num_samples_tot * (1 - perc_train) * 0.5),
-            }[dataset_name]
-            os.makedirs(data_path, exist_ok=True)
-            if not os.listdir(data_path):
-                deterministic_graph_data(
-                    data_path, number_configurations=num_samples
-                )
+            sentinel = data_path.rstrip("/") + f".done.{run_id}"
+            if world_rank == 0:
+                num_samples = {
+                    "total": num_samples_tot,
+                    "train": int(num_samples_tot * perc_train),
+                    "test": int(num_samples_tot * (1 - perc_train) * 0.5),
+                    "validate": int(num_samples_tot * (1 - perc_train) * 0.5),
+                }[dataset_name]
+                os.makedirs(data_path, exist_ok=True)
+                if not os.listdir(data_path):
+                    deterministic_graph_data(
+                        data_path, number_configurations=num_samples
+                    )
+                with open(sentinel, "w"):
+                    pass
+            else:
+                deadline = _time.time() + 300
+                while not os.path.exists(sentinel):
+                    if _time.time() > deadline:
+                        raise TimeoutError(f"rank 0 never finished {data_path}")
+                    _time.sleep(0.1)
 
     # PNA without lengths exercises the config-file overload of run_training
     # (reference test_graphs.py:109-114).
