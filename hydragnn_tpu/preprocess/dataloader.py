@@ -22,7 +22,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..graphs.batch import GraphBatch
-from ..graphs.collate import collate_graphs, compute_pad_sizes
+from ..graphs.collate import GraphArena, compute_pad_sizes
 from ..graphs.sample import GraphSample
 
 
@@ -50,6 +50,7 @@ class GraphDataLoader:
         self.head_dims = tuple(head_dims) if head_dims else None
         self.edge_dim = edge_dim
         self.epoch = 0
+        self._arena = None
         self._build_buckets(max(1, int(num_buckets)))
 
     def _build_buckets(self, num_buckets: int) -> None:
@@ -131,7 +132,7 @@ class GraphDataLoader:
         for bi, bucket in enumerate(self._buckets):
             idx = self._shard(np.asarray(bucket), rng)
             for start in range(0, len(idx), self.batch_size):
-                plan.append((bi, idx[start : start + self.batch_size].tolist()))
+                plan.append((bi, idx[start : start + self.batch_size]))
         if rng is not None and len(self._buckets) > 1:
             rng.shuffle(plan)
         return plan
@@ -140,11 +141,15 @@ class GraphDataLoader:
         return len(self._batch_plan())
 
     def __iter__(self) -> Iterator[GraphBatch]:
+        if self._arena is None and self.dataset:
+            # Built once per dataset: batches become pure numpy gathers over
+            # contiguous arenas (the per-sample Python walk in collate_graphs
+            # caps a prefetch thread well below TPU consumption rate).
+            self._arena = GraphArena(self.dataset)
         for bi, sample_idx in self._batch_plan():
             n_pad, e_pad, g_pad = self._bucket_pads[bi]
-            chunk = [self.dataset[i] for i in sample_idx]
-            yield collate_graphs(
-                chunk,
+            yield self._arena.collate(
+                sample_idx,
                 head_types=self.head_types or (),
                 head_dims=self.head_dims or (),
                 num_nodes_pad=n_pad,

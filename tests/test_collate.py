@@ -65,3 +65,147 @@ def pytest_pad_sizes_fit_worst_batch():
     assert n_pad > 11 + 7
     assert e_pad >= 11 + 7
     assert g_pad == 3
+
+
+def pytest_vectorized_collate_matches_per_sample_unpack():
+    """The vectorized packer must equal a per-sample reference built directly
+    from unpack_targets over random ragged graphs (incl. vector node heads and
+    an edgeless graph)."""
+    import numpy as np
+
+    from hydragnn_tpu.graphs import GraphSample, collate_graphs
+    from hydragnn_tpu.graphs.collate import unpack_targets
+
+    rng = np.random.default_rng(7)
+    head_types, head_dims = ("graph", "node", "node"), (2, 1, 3)
+    graphs = []
+    for k in range(9):
+        n = int(rng.integers(1, 7))
+        e = 0 if k == 4 else int(rng.integers(1, 2 * n + 1))
+        x = rng.normal(size=(n, 2)).astype(np.float32)
+        ei = rng.integers(0, n, size=(2, e)).astype(np.int32)
+        ea = rng.normal(size=(e, 2)).astype(np.float32)
+        parts = [rng.normal(size=(2,)), rng.normal(size=(n,)), rng.normal(size=(n * 3,))]
+        y = np.concatenate(parts).astype(np.float32)
+        y_loc = np.array([[0, 2, 2 + n, 2 + n + n * 3]], dtype=np.int64)
+        graphs.append(
+            GraphSample(x=x, pos=np.zeros((n, 3), np.float32), y=y, y_loc=y_loc,
+                        edge_index=ei, edge_attr=ea)
+        )
+
+    batch = collate_graphs(graphs, head_types, head_dims, edge_dim=1)
+
+    node_off = 0
+    edge_off = 0
+    for gi, s in enumerate(graphs):
+        n, e = s.num_nodes, s.num_edges
+        np.testing.assert_array_equal(
+            batch.node_features[node_off:node_off + n], s.x
+        )
+        assert (batch.node_graph[node_off:node_off + n] == gi).all()
+        if e:
+            np.testing.assert_array_equal(
+                batch.senders[edge_off:edge_off + e], s.edge_index[0] + node_off
+            )
+            np.testing.assert_array_equal(
+                batch.receivers[edge_off:edge_off + e], s.edge_index[1] + node_off
+            )
+            np.testing.assert_array_equal(
+                batch.edge_features[edge_off:edge_off + e], s.edge_attr[:, :1]
+            )
+        per_head = unpack_targets(s, head_types, head_dims)
+        np.testing.assert_allclose(batch.targets[0][gi], per_head[0])
+        np.testing.assert_allclose(
+            batch.targets[1][node_off:node_off + n], per_head[1]
+        )
+        np.testing.assert_allclose(
+            batch.targets[2][node_off:node_off + n], per_head[2]
+        )
+        node_off += n
+        edge_off += e
+    # padding rows untouched
+    assert not batch.node_mask[node_off:].any()
+    assert not batch.edge_mask[edge_off:].any()
+
+
+def pytest_arena_collate_matches_collate_graphs():
+    """GraphArena.collate must produce byte-identical batches to
+    collate_graphs for arbitrary sample subsets, paddings, and head specs."""
+    import numpy as np
+
+    from hydragnn_tpu.graphs import GraphSample, collate_graphs
+    from hydragnn_tpu.graphs.collate import GraphArena
+
+    rng = np.random.default_rng(3)
+    head_types, head_dims = ("graph", "node"), (1, 2)
+    graphs = []
+    for k in range(12):
+        n = int(rng.integers(2, 9))
+        e = 0 if k == 5 else int(rng.integers(1, 3 * n))
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        ei = rng.integers(0, n, size=(2, e)).astype(np.int32)
+        ea = rng.normal(size=(e, 1)).astype(np.float32)
+        y = np.concatenate([rng.normal(size=(1,)), rng.normal(size=(n * 2,))])
+        y_loc = np.array([[0, 1, 1 + n * 2]], dtype=np.int64)
+        graphs.append(
+            GraphSample(x=x, pos=np.zeros((n, 3), np.float32),
+                        y=y.astype(np.float32), y_loc=y_loc,
+                        edge_index=ei, edge_attr=ea)
+        )
+    arena = GraphArena(graphs)
+    for idx in ([0, 3, 5, 7], [11, 2], list(range(12))):
+        a = arena.collate(idx, head_types, head_dims, edge_dim=1)
+        b = collate_graphs([graphs[i] for i in idx], head_types, head_dims,
+                           edge_dim=1)
+        for fa, fb in zip(
+            (a.node_features, a.senders, a.receivers, a.node_graph,
+             a.node_mask, a.edge_mask, a.graph_mask, a.edge_features,
+             *a.targets),
+            (b.node_features, b.senders, b.receivers, b.node_graph,
+             b.node_mask, b.edge_mask, b.graph_mask, b.edge_features,
+             *b.targets),
+        ):
+            np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+        assert a.num_graphs_pad == b.num_graphs_pad
+
+
+def pytest_arena_edge_cases():
+    """Mixed edge_attr presence packs the attrs that exist (zeros for absent);
+    unlabeled datasets collate fine without head_types and refuse with them;
+    head_dims inconsistent with y_loc raise instead of silently truncating."""
+    import numpy as np
+    import pytest as _pytest
+
+    from hydragnn_tpu.graphs import GraphSample
+    from hydragnn_tpu.graphs.collate import GraphArena
+
+    def mk(n, e, attr, labeled=True):
+        y = np.arange(1 + n, dtype=np.float32) if labeled else None
+        y_loc = np.array([[0, 1, 1 + n]], dtype=np.int64) if labeled else None
+        return GraphSample(
+            x=np.ones((n, 1), np.float32), pos=np.zeros((n, 3), np.float32),
+            y=y, y_loc=y_loc,
+            edge_index=np.zeros((2, e), np.int32),
+            edge_attr=np.full((e, 1), 5.0, np.float32) if attr else None,
+        )
+
+    # Mixed attrs: sample 0 has attrs, sample 1 doesn't.
+    arena = GraphArena([mk(2, 2, True), mk(2, 2, False)])
+    batch = arena.collate([0, 1], ("graph", "node"), (1, 1), edge_dim=1)
+    np.testing.assert_array_equal(
+        batch.edge_features[:4, 0], [5.0, 5.0, 0.0, 0.0]
+    )
+
+    # Unlabeled: no heads OK, heads requested -> error.
+    arena_u = GraphArena([mk(2, 1, True, labeled=False)])
+    b = arena_u.collate([0])
+    assert b.targets == ()
+    with _pytest.raises(ValueError, match="unlabeled"):
+        arena_u.collate([0], ("graph",), (1,))
+
+    # Declared dims inconsistent with y_loc spans -> error, not silent reads.
+    arena_l = GraphArena([mk(3, 1, True)])
+    with _pytest.raises(ValueError, match="spans"):
+        arena_l.collate([0], ("graph", "node"), (2, 1))
+    with _pytest.raises(ValueError, match="spans"):
+        arena_l.collate([0], ("graph", "node"), (1, 2))
