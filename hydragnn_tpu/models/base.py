@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from ..graphs.batch import GraphBatch
+from ..ops import pallas_segment
 from ..ops import segment as seg
 from .layers import MLP, MaskedBatchNorm
 from .convs import CGConv, GATv2Conv, GINConv, MFCConv, PNAConv, SAGEConv
@@ -291,7 +292,7 @@ class HydraGNN(nn.Module):
             x = nn.relu(bn(c, batch.node_mask, train))
 
         # Masked global mean pool (Base.py:247-250).
-        x_graph = seg.segment_mean(
+        x_graph = pallas_segment.fused_segment_mean(
             x, batch.node_graph, batch.num_graphs_pad, mask=batch.node_mask
         )
 

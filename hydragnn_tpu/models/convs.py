@@ -33,7 +33,7 @@ class SAGEConv(nn.Module):
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
         n = x.shape[0]
-        nbr = seg.segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
+        nbr = pallas_segment.fused_segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
         return nn.Dense(self.out_dim, name="lin_nbr")(nbr) + nn.Dense(
             self.out_dim, name="lin_self"
         )(x)
@@ -51,7 +51,7 @@ class GINConv(nn.Module):
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
         n = x.shape[0]
         eps = self.param("eps", nn.initializers.constant(self.eps_init), ())
-        agg = seg.segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
+        agg = pallas_segment.fused_segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)
@@ -77,9 +77,10 @@ class MFCConv(nn.Module):
         )
         w_nbr = self.param("w_nbr", nn.initializers.lecun_normal(), (d, f, self.out_dim))
         b = self.param("bias", nn.initializers.zeros, (d, self.out_dim))
-        deg = seg.segment_count(receivers, n, mask=edge_mask, axis_name=self.axis_name).astype(jnp.int32)
-        deg = jnp.clip(deg, 0, self.max_degree)
-        agg = seg.segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
+        agg, deg_f = pallas_segment.fused_segment_sum_count(
+            x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name
+        )
+        deg = jnp.clip(deg_f.astype(jnp.int32), 0, self.max_degree)
         out = jnp.einsum("nf,nfo->no", x, w_self[deg]) + jnp.einsum(
             "nf,nfo->no", agg, w_nbr[deg]
         )
@@ -126,7 +127,7 @@ class GATv2Conv(nn.Module):
             alpha = jnp.where(keep, alpha / (1.0 - self.dropout), 0.0)
         msgs = x_src[s] * alpha[..., None]  # [E', h, f]
         msgs = jnp.where(m[:, None, None], msgs, 0.0)
-        out = seg.segment_sum(msgs, r, n, axis_name=self.axis_name)  # [N, h, f]
+        out = pallas_segment.fused_segment_sum(msgs, r, n, axis_name=self.axis_name)  # [N, h, f]
         if self.concat:
             out = out.reshape(n, h * f)
             bias = self.param("bias", nn.initializers.zeros, (h * f,))
@@ -156,7 +157,7 @@ class CGConv(nn.Module):
         msgs = gate * core
         # Padding edges carry nonzero softplus output — mask before aggregation.
         msgs = jnp.where(edge_mask[:, None], msgs, 0.0)
-        return x + seg.segment_sum(msgs, receivers, n, axis_name=self.axis_name)
+        return x + pallas_segment.fused_segment_sum(msgs, receivers, n, axis_name=self.axis_name)
 
 
 class PNAConv(nn.Module):

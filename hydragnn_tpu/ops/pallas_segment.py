@@ -476,6 +476,81 @@ def certify_pallas(
     }
 
 
+def _flatten_trailing(data):
+    """[E, ...] → ([E, F], unflatten) for the 2-D kernel."""
+    if data.ndim == 2:
+        return data, lambda x: x
+    shape = data.shape
+    if data.ndim == 1:
+        return data[:, None], lambda x: x[:, 0]
+    return data.reshape(shape[0], -1), lambda x: x.reshape(
+        (x.shape[0],) + shape[1:]
+    )
+
+
+def fused_segment_sum(
+    data, segment_ids, num_segments: int, mask=None, axis_name=None
+):
+    """Drop-in masked ``segment_sum`` that rides the one-hot MXU kernel on TPU
+    (XLA's TPU scatter-add serializes updates) — used by every conv family's
+    aggregation, not just PNA. Falls back to the XLA path off-TPU. Accepts any
+    [E, ...] float data (trailing dims flattened for the kernel)."""
+    total, _ = fused_segment_sum_count(
+        data, segment_ids, num_segments, mask=mask, axis_name=axis_name
+    )
+    return total
+
+
+def fused_segment_sum_count(
+    data, segment_ids, num_segments: int, mask=None, axis_name=None
+):
+    """Masked (segment_sum, segment_count) in ONE fused pass — callers that
+    need both (MFC's degree lookup) save a whole scatter. Falls back to the
+    two XLA ops off-TPU."""
+    if not pallas_enabled():
+        return (
+            seg.segment_sum(
+                data, segment_ids, num_segments, mask=mask, axis_name=axis_name
+            ),
+            seg.segment_count(
+                segment_ids, num_segments, mask=mask, axis_name=axis_name
+            ),
+        )
+    flat, unflatten = _flatten_trailing(data)
+    ids = segment_ids.astype(jnp.int32)
+    if mask is not None:
+        ids = jnp.where(mask, ids, -1)
+    # The hi/lo split only buys accuracy when the input has more mantissa
+    # bits than bf16 — for bf16 activations (mixed precision) lo == 0 and the
+    # second matmul would be pure waste.
+    split = flat.dtype != jnp.bfloat16
+    total, count = segment_sum_count(
+        flat, ids, num_segments, _platform() != "tpu", split
+    )
+    if axis_name is not None:
+        total = jax.lax.psum(total, axis_name)
+        count = jax.lax.psum(count, axis_name)
+    return unflatten(total.astype(data.dtype)), count
+
+
+def fused_segment_mean(
+    data, segment_ids, num_segments: int, mask=None, axis_name=None
+):
+    """Drop-in masked ``segment_mean`` over the fused kernel (SAGE neighbor
+    mean, the global mean-pool readout). Both paths return ``data.dtype`` so
+    CPU-fallback and TPU runs agree on dtype flow."""
+    if not pallas_enabled():
+        return seg.segment_mean(
+            data, segment_ids, num_segments, mask=mask, axis_name=axis_name
+        ).astype(data.dtype)
+    flat, unflatten = _flatten_trailing(data)
+    _, mean, _, _ = fused_segment_stats(
+        flat, segment_ids, num_segments, mask=mask, axis_name=axis_name,
+        want_std=False,
+    )
+    return unflatten(mean.astype(data.dtype))
+
+
 def pna_aggregate(
     msg: jnp.ndarray,
     receivers: jnp.ndarray,

@@ -127,3 +127,42 @@ def pytest_centered_std_beats_uncentered_on_degenerate_segments():
     err_xla = float(np.abs(np.asarray(std_xla, np.float64) - ref).max())
     assert err_fused < 1e-4, err_fused
     assert err_fused < err_xla  # strictly better than the uncentered form
+
+
+def pytest_fused_dropin_wrappers_match_xla(monkeypatch):
+    """fused_segment_sum/mean (the drop-ins every conv family now routes
+    through) must match the masked XLA ops — incl. 3-D GAT-shaped data and a
+    bf16 input whose output dtype must be preserved."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "1")  # force the kernel (interpreter off-TPU)
+    rng = np.random.default_rng(1)
+    data, ids, mask, n = _random_problem(rng)
+
+    np.testing.assert_allclose(
+        ps.fused_segment_sum(data, ids, n, mask=mask),
+        seg.segment_sum(data, ids, n, mask=mask),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        ps.fused_segment_mean(data, ids, n, mask=mask),
+        seg.segment_mean(data, ids, n, mask=mask),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    # 3-D (GAT multi-head messages [E, h, f]); no mask.
+    d3 = jnp.asarray(rng.normal(size=(64, 3, 5)).astype(np.float32))
+    ids3 = jnp.asarray(rng.integers(0, 10, size=64).astype(np.int32))
+    np.testing.assert_allclose(
+        ps.fused_segment_sum(d3, ids3, 10),
+        seg.segment_sum(d3, ids3, 10),
+        rtol=1e-5, atol=1e-5,
+    )
+
+    # bf16 in → bf16 out (mixed-precision dtype flow preserved).
+    dbf = data.astype(jnp.bfloat16)
+    out = ps.fused_segment_sum(dbf, ids, n, mask=mask)
+    assert out.dtype == jnp.bfloat16
+
+    # Gradients flow (gather backward), masked rows get zero cotangent.
+    g = jax.grad(lambda d: ps.fused_segment_sum(d, ids, n, mask=mask).sum())(data)
+    g_ref = jax.grad(lambda d: seg.segment_sum(d, ids, n, mask=mask).sum())(data)
+    np.testing.assert_allclose(g, g_ref, rtol=1e-5, atol=1e-5)
