@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from ..ops import pallas_segment, segment as seg
+from ..ops import pallas_segment
 
 
 class SAGEConv(nn.Module):
@@ -120,7 +120,9 @@ class GATv2Conv(nn.Module):
         att = self.param("att", nn.initializers.lecun_normal(), (h, f))
         pre = nn.leaky_relu(x_src[s] + x_dst[r], self.negative_slope)  # [E', h, f]
         logits = jnp.einsum("ehf,hf->eh", pre, att)
-        alpha = seg.segment_softmax(logits, r, n, mask=m, axis_name=self.axis_name)  # [E', h]
+        alpha = pallas_segment.fused_segment_softmax(
+            logits, r, n, mask=m, axis_name=self.axis_name
+        )  # [E', h]
         if train and self.dropout > 0.0:
             rng = self.make_rng("dropout")
             keep = jax.random.bernoulli(rng, 1.0 - self.dropout, alpha.shape)

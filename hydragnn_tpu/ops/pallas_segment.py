@@ -191,7 +191,28 @@ def _sum_count_pallas(
     return out_sum[:num_segments, :f], out_cnt[:num_segments, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _sum_count_vjp(data, ids, num_segments, interpret, split, dtype_name):
+    return _sum_count_pallas(data, ids, num_segments, interpret, split)
+
+
+def _sum_count_fwd(data, ids, num_segments, interpret, split, dtype_name):
+    out = _sum_count_pallas(data, ids, num_segments, interpret, split)
+    return out, ids
+
+
+def _sum_count_bwd(num_segments, interpret, split, dtype_name, ids, cots):
+    d_sum, d_cnt = cots
+    del d_cnt  # count has no data dependence
+    valid = (ids >= 0)[:, None]
+    idx = jnp.clip(ids, 0, num_segments - 1)
+    d_data = jnp.where(valid, d_sum[idx], 0.0)
+    return d_data.astype(dtype_name), jnp.zeros(ids.shape, jax.dtypes.float0)
+
+
+_sum_count_vjp.defvjp(_sum_count_fwd, _sum_count_bwd)
+
+
 def segment_sum_count(
     data, ids, num_segments: int, interpret: bool = False, split: bool = True
 ):
@@ -202,27 +223,14 @@ def segment_sum_count(
     ``split=True`` uses the bf16 hi/lo two-matmul trick for ~f32 accuracy;
     ``split=False`` is single-pass bf16 (for inputs without cancellation risk,
     e.g. sums of squares). Differentiable w.r.t. ``data`` (gather backward).
+
+    The primal dtype rides as a STATIC argument — a zero-size carrier array in
+    the residuals (the previous design) picks up an inconsistent sharding
+    under ``shard_map`` and breaks the graph-parallel backward.
     """
-    return _sum_count_pallas(data, ids, num_segments, interpret, split)
-
-
-def _sum_count_fwd(data, ids, num_segments, interpret, split):
-    out = _sum_count_pallas(data, ids, num_segments, interpret, split)
-    # Zero-size carrier for the primal dtype (residuals must be JAX types).
-    return out, (ids, jnp.zeros((0,), data.dtype))
-
-
-def _sum_count_bwd(num_segments, interpret, split, res, cots):
-    ids, dtype_carrier = res
-    d_sum, d_cnt = cots
-    del d_cnt  # count has no data dependence
-    valid = (ids >= 0)[:, None]
-    idx = jnp.clip(ids, 0, num_segments - 1)
-    d_data = jnp.where(valid, d_sum[idx], 0.0)
-    return d_data.astype(dtype_carrier.dtype), jnp.zeros(ids.shape, jax.dtypes.float0)
-
-
-segment_sum_count.defvjp(_sum_count_fwd, _sum_count_bwd)
+    return _sum_count_vjp(
+        data, ids, num_segments, interpret, split, str(data.dtype)
+    )
 
 
 def _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std):
@@ -543,12 +551,45 @@ def fused_segment_mean(
         return seg.segment_mean(
             data, segment_ids, num_segments, mask=mask, axis_name=axis_name
         ).astype(data.dtype)
-    flat, unflatten = _flatten_trailing(data)
-    _, mean, _, _ = fused_segment_stats(
-        flat, segment_ids, num_segments, mask=mask, axis_name=axis_name,
-        want_std=False,
+    total, count = fused_segment_sum_count(
+        data, segment_ids, num_segments, mask=mask, axis_name=axis_name
     )
-    return unflatten(mean.astype(data.dtype))
+    safe = jnp.maximum(count, 1.0).reshape(
+        count.shape + (1,) * (total.ndim - count.ndim)
+    )
+    return (total / safe).astype(data.dtype)
+
+
+def fused_segment_softmax(
+    logits, segment_ids, num_segments: int, mask=None, axis_name=None
+):
+    """Segment softmax (GATv2 attention over incoming edges) with the
+    denominator's scatter on the fused MXU kernel. The per-segment max stays
+    on XLA ``segment_max`` (elementwise extrema can't ride the MXU), matching
+    ``seg.segment_softmax`` numerics; off-TPU falls back to it outright."""
+    if not pallas_enabled():
+        return seg.segment_softmax(
+            logits, segment_ids, num_segments, mask=mask, axis_name=axis_name
+        )
+    big = 1e9
+    shifted_in = logits
+    if mask is not None:
+        shifted_in = jnp.where(seg._expand(mask, logits), logits, -big)
+    seg_max = jax.ops.segment_max(
+        shifted_in, segment_ids, num_segments=num_segments
+    )
+    if axis_name is not None:
+        # seg._pmax (all_gather+max), NOT lax.pmax: pmax has no VJP rule, and
+        # attention weights must stay differentiable under graph parallelism.
+        seg_max = seg._pmax(seg_max, axis_name)
+    seg_max = jnp.where(seg_max <= -big / 2, 0.0, seg_max)
+    exp = jnp.exp(shifted_in - seg_max[segment_ids])
+    if mask is not None:
+        exp = jnp.where(seg._expand(mask, exp), exp, 0.0)
+    denom = fused_segment_sum(
+        exp, segment_ids, num_segments, mask=mask, axis_name=axis_name
+    )
+    return exp / jnp.maximum(denom[segment_ids], 1e-16)
 
 
 def pna_aggregate(
