@@ -8,8 +8,9 @@ Runs on whatever devices exist: a real TPU slice, or a virtual CPU mesh:
     python benchmarks/scaling.py            # all visible devices
     python benchmarks/scaling.py --devices 8 --cpu
 
-Prints one JSON line per mesh size:
-  {"devices": D, "graphs_per_sec": X, "efficiency": X / (D * X_1dev)}
+Prints one JSON line per mesh size ("devices" = data_axis * graph_axis):
+  {"devices": D, "mesh": "data:dxgraph:g", "graphs_per_sec": X,
+   "per_device": X/D, "efficiency": X / (data_axis * X_smallest_mesh)}
 """
 
 from __future__ import annotations
@@ -34,6 +35,12 @@ def main():
     ap.add_argument("--cpu", action="store_true", help="force a virtual CPU mesh")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--layers", type=int, default=3)
+    ap.add_argument(
+        "--graph-axis", type=int, default=1,
+        help="shard each graph's edges over this many devices (the "
+        "long-context analog axis); the data axis still sweeps 1,2,4,... "
+        "so each line uses data_axis*graph_axis devices",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -61,12 +68,21 @@ def main():
 
     n_avail = len(jax.devices())
     max_dev = min(args.devices or n_avail, n_avail)
-    sizes = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= max_dev]
+    ga = max(1, args.graph_axis)
+    sizes = [
+        d for d in (1, 2, 4, 8, 16, 32, 64)
+        if d * ga <= max_dev
+    ]
+
+    if not sizes:
+        sys.exit(
+            f"graph_axis={ga} needs more devices than the {max_dev} available"
+        )
 
     rng = np.random.default_rng(0)
     base = None
     for d in sizes:
-        mesh = make_mesh(data_axis=d, graph_axis=1)
+        mesh = make_mesh(data_axis=d, graph_axis=ga)
         per_dev = [
             collate_graphs(
                 _make_graphs(PER_DEV_BATCH, rng, 12, 26), TYPES, DIMS,
@@ -80,6 +96,10 @@ def main():
         batch = stack_batches(per_dev, d)
         model = _build_model(hidden=args.hidden, layers=args.layers)
         variables = init_model_variables(model, per_dev[0])
+        if ga > 1:
+            # Bind the collective axis only for the sharded step (init ran
+            # outside shard_map where the axis is unbound).
+            model = model.clone(graph_axis="graph")
         opt = select_optimizer("AdamW", 1e-3)
         state = create_train_state(model, variables, opt)
         step = make_train_step_dp(model, opt, mesh)
@@ -99,9 +119,10 @@ def main():
         print(
             json.dumps(
                 {
-                    "devices": d,
+                    "devices": d * ga,
+                    "mesh": f"data:{d}xgraph:{ga}",
                     "graphs_per_sec": round(gps, 1),
-                    "per_device": round(gps / d, 1),
+                    "per_device": round(gps / (d * ga), 1),
                     "efficiency": round(gps / (d * base), 3),
                 }
             ),

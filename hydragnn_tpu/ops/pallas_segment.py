@@ -564,32 +564,15 @@ def fused_segment_softmax(
     logits, segment_ids, num_segments: int, mask=None, axis_name=None
 ):
     """Segment softmax (GATv2 attention over incoming edges) with the
-    denominator's scatter on the fused MXU kernel. The per-segment max stays
-    on XLA ``segment_max`` (elementwise extrema can't ride the MXU), matching
-    ``seg.segment_softmax`` numerics; off-TPU falls back to it outright."""
-    if not pallas_enabled():
-        return seg.segment_softmax(
-            logits, segment_ids, num_segments, mask=mask, axis_name=axis_name
-        )
-    big = 1e9
-    shifted_in = logits
-    if mask is not None:
-        shifted_in = jnp.where(seg._expand(mask, logits), logits, -big)
-    seg_max = jax.ops.segment_max(
-        shifted_in, segment_ids, num_segments=num_segments
+    denominator's scatter on the fused MXU kernel — one shared stabilization
+    body (seg.segment_softmax) with the sum injected, so the TPU and fallback
+    paths cannot drift. The per-segment max stays on XLA ``segment_max``
+    (extrema can't ride the MXU) under stop_gradient, so no scatter appears
+    in the backward either."""
+    return seg.segment_softmax(
+        logits, segment_ids, num_segments, mask=mask, axis_name=axis_name,
+        sum_fn=fused_segment_sum if pallas_enabled() else None,
     )
-    if axis_name is not None:
-        # seg._pmax (all_gather+max), NOT lax.pmax: pmax has no VJP rule, and
-        # attention weights must stay differentiable under graph parallelism.
-        seg_max = seg._pmax(seg_max, axis_name)
-    seg_max = jnp.where(seg_max <= -big / 2, 0.0, seg_max)
-    exp = jnp.exp(shifted_in - seg_max[segment_ids])
-    if mask is not None:
-        exp = jnp.where(seg._expand(mask, exp), exp, 0.0)
-    denom = fused_segment_sum(
-        exp, segment_ids, num_segments, mask=mask, axis_name=axis_name
-    )
-    return exp / jnp.maximum(denom[segment_ids], 1e-16)
 
 
 def pna_aggregate(

@@ -136,24 +136,38 @@ def segment_softmax(
     num_segments: int,
     mask: Optional[jnp.ndarray] = None,
     axis_name: Optional[str] = None,
+    sum_fn=None,
 ) -> jnp.ndarray:
     """Numerically-stable softmax normalized within each segment (GATv2 attention
     over incoming edges). Masked-out rows get weight 0. Under graph parallelism
     the per-segment max and denominator are reduced globally; the returned
-    weights are for the LOCAL edge shard."""
+    weights are for the LOCAL edge shard.
+
+    ``sum_fn(data, ids, n, mask=, axis_name=)`` overrides the denominator's
+    segment sum (must return the globally-reduced sum) — the hook the fused
+    Pallas kernel plugs into so both paths share ONE stabilization body."""
     if mask is not None:
         logits = jnp.where(_expand(mask, logits), logits, -_BIG)
     seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
     if axis_name is not None:
         seg_max = _pmax(seg_max, axis_name)
     seg_max = jnp.where(seg_max <= -_BIG / 2, 0.0, seg_max)
+    # Softmax is shift-invariant, so the max is analytically a constant:
+    # stop_gradient gives the identical gradient while skipping
+    # segment_max's scatter-heavy TPU VJP (jax.nn.softmax does the same).
+    seg_max = jax.lax.stop_gradient(seg_max)
     shifted = logits - seg_max[segment_ids]
     exp = jnp.exp(shifted)
     if mask is not None:
         exp = jnp.where(_expand(mask, exp), exp, 0.0)
-    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
-    if axis_name is not None:
-        denom = jax.lax.psum(denom, axis_name)
+    if sum_fn is not None:
+        denom = sum_fn(
+            exp, segment_ids, num_segments, mask=mask, axis_name=axis_name
+        )
+    else:
+        denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+        if axis_name is not None:
+            denom = jax.lax.psum(denom, axis_name)
     return exp / jnp.maximum(denom[segment_ids], 1e-16)
 
 
