@@ -83,11 +83,14 @@ def main():
     base = None
     for d in sizes:
         mesh = make_mesh(data_axis=d, graph_axis=ga)
+        # Edge arrays are sharded over the graph axis: round the pad up to a
+        # multiple of ga so shard_map's divisibility requirement holds.
+        e_pad = -(-(PER_DEV_BATCH * 26 * 20) // ga) * ga
         per_dev = [
             collate_graphs(
                 _make_graphs(PER_DEV_BATCH, rng, 12, 26), TYPES, DIMS,
                 num_nodes_pad=PER_DEV_BATCH * 26,
-                num_edges_pad=PER_DEV_BATCH * 26 * 20,
+                num_edges_pad=e_pad,
                 num_graphs_pad=PER_DEV_BATCH + 1,
                 edge_dim=1,
             )
