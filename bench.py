@@ -234,9 +234,44 @@ def _production_workload():
 
 def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
-    'remote_compile: Connection refused') — retryable; real failures are not."""
+    'remote_compile: Connection refused') or probe timeouts — retryable;
+    real failures are not."""
     msg = f"{type(e).__name__}: {e}"
-    return "UNAVAILABLE" in msg or "Connection refused" in msg
+    return (
+        "UNAVAILABLE" in msg
+        or "Connection refused" in msg
+        or "no response in" in msg
+    )
+
+
+def _probe_device(timeout_s: float = 180.0) -> None:
+    """Bounded reachability check. A dead tunnel makes the first device op
+    BLOCK (no exception), which would hang the whole benchmark with no
+    artifact; probing in a daemon thread converts that into a raise, which
+    main() turns into the diagnostic JSON line."""
+    import threading
+
+    state: dict = {}
+
+    def _t():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        except Exception as e:  # surfaced on the main thread below
+            state["err"] = e
+
+    th = threading.Thread(target=_t, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        raise TimeoutError(
+            f"device unreachable: no response in {timeout_s:.0f}s "
+            "(accelerator tunnel down?)"
+        )
+    if "err" in state:
+        raise state["err"]
 
 
 def _with_retries(fn, attempts=3, backoff_s=60.0):
@@ -259,6 +294,7 @@ def main():
     try:
         import jax
 
+        _with_retries(_probe_device)  # fail fast (with artifact) on dead tunnel
         result["backend"] = jax.default_backend()
         result["device_kind"] = jax.devices()[0].device_kind
         result.update(_with_retries(_peak_workload))
