@@ -236,12 +236,10 @@ def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
     'remote_compile: Connection refused') or probe timeouts — retryable;
     real failures are not."""
+    if isinstance(e, TimeoutError):  # _probe_device's bounded reachability
+        return True
     msg = f"{type(e).__name__}: {e}"
-    return (
-        "UNAVAILABLE" in msg
-        or "Connection refused" in msg
-        or "no response in" in msg
-    )
+    return "UNAVAILABLE" in msg or "Connection refused" in msg
 
 
 def _probe_device(timeout_s: float = 180.0) -> None:
