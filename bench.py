@@ -272,13 +272,19 @@ def _probe_device(timeout_s: float = 180.0) -> None:
         raise state["err"]
 
 
+_RETRIES_USED = 0  # reported in the artifact: a retried measurement reruns the
+# whole workload with warm caches, so its timing is not comparable to a clean run
+
+
 def _with_retries(fn, attempts=3, backoff_s=60.0):
+    global _RETRIES_USED
     for i in range(attempts):
         try:
             return fn()
         except Exception as e:
             if i == attempts - 1 or not _transient(e):
                 raise
+            _RETRIES_USED += 1
             time.sleep(backoff_s * (i + 1))
 
 
@@ -326,8 +332,10 @@ def main():
 
         result["error"] = f"{type(e).__name__}: {e}"
         result["trace_tail"] = traceback.format_exc()[-1500:]
+        result["retries"] = _RETRIES_USED
         print(json.dumps(result))
         sys.exit(1)
+    result["retries"] = _RETRIES_USED
     print(json.dumps(result))
 
 

@@ -84,7 +84,14 @@ class GraphArena:
     dataset's arrays (float32/int32) for the loader's lifetime — datasets are
     host-RAM sized in this framework (the reference holds them on the
     accelerator, serialized_dataset_loader.py:137-140), so ~2x host arrays is
-    the cost of feeding the chip at line rate."""
+    the cost of feeding the chip at line rate.
+
+    Edge-feature semantics: presence and width are resolved ONCE at arena
+    (dataset) level from the first edge-bearing sample carrying ``edge_attr``
+    — not per batch. A batch whose own graphs all lack ``edge_attr`` still
+    gets zero-filled ``edge_features`` (not None) when any other sample in
+    the dataset has them, keeping the batch pytree structure identical across
+    batches (one jit trace per pad shape instead of two)."""
 
     def __init__(self, graphs: Sequence[GraphSample]):
         g = len(graphs)

@@ -96,6 +96,13 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _wants_split(dtype) -> bool:
+    """Single source of the hi/lo accuracy-split policy: the split only buys
+    accuracy when the input has more mantissa bits than bf16 — for bf16
+    activations (mixed precision) lo == 0 and the extra pass is pure waste."""
+    return dtype != jnp.bfloat16
+
+
 def _sum_count_kernel(ids_ref, data_ref, sum_ref, cnt_ref):
     import jax.experimental.pallas as pl
 
@@ -149,13 +156,36 @@ def _sum_count_pallas(
 
     e, f = data.shape
     e_pad = _round_up(max(e, _BE), _BE)
-    f_pad = _round_up(max(f, 128), 128)
     n_pad = _round_up(max(num_segments, _BN), _BN)
-
-    data_p = jnp.zeros((e_pad, f_pad), jnp.float32).at[:e, :f].set(
-        data.astype(jnp.float32)
-    )
     ids_p = jnp.full((1, e_pad), -1, jnp.int32).at[0, :e].set(ids.astype(jnp.int32))
+
+    data32 = data.astype(jnp.float32)
+    # f-packing: at f <= 64 the hi/lo pair fits side-by-side in one 128-lane
+    # tile (hi in lanes [0:f], lo lane-aligned at [64:64+f]), so the accuracy
+    # split costs ZERO extra MXU work — the un-packed split path pays 2x. The
+    # one-hot factor is shared, so one matmul yields both column groups and the
+    # final hi+lo add happens in f32 outside the kernel.
+    packed = split and 2 * f <= 128
+    if packed:
+        f_pad = 128
+        hi = data32.astype(jnp.bfloat16).astype(jnp.float32)
+        data_p = (
+            jnp.zeros((e_pad, f_pad), jnp.float32)
+            .at[:e, :f].set(hi)
+            .at[:e, 64 : 64 + f].set(data32 - hi)
+        )
+        operands = (data_p,)
+        kernel = _sum_count_kernel
+    else:
+        f_pad = _round_up(max(f, 128), 128)
+        data_p = jnp.zeros((e_pad, f_pad), jnp.float32).at[:e, :f].set(data32)
+        if split:
+            hi = data_p.astype(jnp.bfloat16).astype(jnp.float32)
+            operands = (hi, data_p - hi)
+            kernel = _sum_count_split_kernel
+        else:
+            operands = (data_p,)
+            kernel = _sum_count_kernel
 
     grid = (n_pad // _BN, e_pad // _BE)
     edge_spec = pl.BlockSpec((_BE, f_pad), lambda i, j: (j, 0))
@@ -168,27 +198,18 @@ def _sum_count_pallas(
         jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
     ]
     ids_spec = pl.BlockSpec((1, _BE), lambda i, j: (0, j))
-    if split:
-        hi = data_p.astype(jnp.bfloat16).astype(jnp.float32)
-        lo = data_p - hi
-        out_sum, out_cnt = pl.pallas_call(
-            _sum_count_split_kernel,
-            grid=grid,
-            in_specs=[ids_spec, edge_spec, edge_spec],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(ids_p, hi, lo)
-    else:
-        out_sum, out_cnt = pl.pallas_call(
-            _sum_count_kernel,
-            grid=grid,
-            in_specs=[ids_spec, edge_spec],
-            out_specs=out_specs,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(ids_p, data_p)
-    return out_sum[:num_segments, :f], out_cnt[:num_segments, 0]
+    out_sum, out_cnt = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[ids_spec] + [edge_spec] * len(operands),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(ids_p, *operands)
+    total = out_sum[:num_segments, :f]
+    if packed:
+        total = total + out_sum[:num_segments, 64 : 64 + f]
+    return total, out_cnt[:num_segments, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
@@ -220,9 +241,11 @@ def segment_sum_count(
 
     ``ids`` < 0 marks masked/padding rows (excluded from both outputs).
     ``data``: [E, F] float; ``ids``: [E] int. Returns ``(sum [N,F], count [N])``.
-    ``split=True`` uses the bf16 hi/lo two-matmul trick for ~f32 accuracy;
-    ``split=False`` is single-pass bf16 (for inputs without cancellation risk,
-    e.g. sums of squares). Differentiable w.r.t. ``data`` (gather backward).
+    ``split=True`` uses the bf16 hi/lo trick for ~f32 accuracy — free when
+    f <= 64 (hi/lo pack side-by-side into one 128-lane tile and share the
+    one-hot matmul), two matmuls otherwise; ``split=False`` is single-pass
+    bf16 (for inputs without cancellation risk, e.g. sums of squares).
+    Differentiable w.r.t. ``data`` (gather backward).
 
     The primal dtype rides as a STATIC argument — a zero-size carrier array in
     the residuals (the previous design) picks up an inconsistent sharding
@@ -234,7 +257,9 @@ def segment_sum_count(
 
 
 def _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std):
-    total, count = segment_sum_count(data, ids, num_segments, interpret, True)
+    total, count = segment_sum_count(
+        data, ids, num_segments, interpret, _wants_split(data.dtype)
+    )
     if axis_name is not None:
         total = jax.lax.psum(total, axis_name)
         count = jax.lax.psum(count, axis_name)
@@ -386,75 +411,99 @@ def certify_pallas(
 
     import numpy as np
 
-    key = jax.random.PRNGKey(seed)
-    k1, k2, k3 = jax.random.split(key, 3)
-    data = jax.random.normal(k1, (e, f), jnp.float32) * 2.0 + 1.0
-    ids = jax.random.randint(k2, (e,), 0, n)
-    mask = jax.random.uniform(k3, (e,)) > 0.1
+    def _problem(e_, f_, n_, seed_):
+        key = jax.random.PRNGKey(seed_)
+        k1, k2, k3 = jax.random.split(key, 3)
+        data = jax.random.normal(k1, (e_, f_), jnp.float32) * 2.0 + 1.0
+        ids = jax.random.randint(k2, (e_,), 0, n_)
+        mask = jax.random.uniform(k3, (e_,)) > 0.1
+        return data, ids, mask
 
-    def fused_bundle(d):
-        total, mean, std, count = fused_segment_stats(d, ids, n, mask=mask)
-        return total, mean, std, count
+    def _bundles(ids, mask, n_):
+        def fused_bundle(d):
+            return fused_segment_stats(d, ids, n_, mask=mask)
 
-    def xla_bundle(d):
-        safe = jnp.where(mask, ids, 0)
-        return (
-            seg.segment_sum(d, safe, n, mask=mask),
-            seg.segment_mean(d, safe, n, mask=mask),
-            seg.segment_std(d, safe, n, mask=mask),
-            seg.segment_count(safe, n, mask=mask),
+        def xla_bundle(d):
+            safe = jnp.where(mask, ids, 0)
+            return (
+                seg.segment_sum(d, safe, n_, mask=mask),
+                seg.segment_mean(d, safe, n_, mask=mask),
+                seg.segment_std(d, safe, n_, mask=mask),
+                seg.segment_count(safe, n_, mask=mask),
+            )
+
+        def scalarize(bundle):
+            def fn(d):
+                total, mean, std, count = bundle(d)
+                # All three differentiable outputs contribute to the cotangent.
+                return jnp.sum(total * 0.3 + mean * 1.7 - std * 0.9)
+
+            return fn
+
+        return fused_bundle, xla_bundle, scalarize
+
+    def _accuracy(data, ids, mask, n_):
+        """(fused fwd/grad err, xla fwd/grad err) vs an f64 host ground truth."""
+        e_, f_ = data.shape
+        fused_bundle, xla_bundle, scalarize = _bundles(ids, mask, n_)
+        f_fused = jax.jit(fused_bundle)
+        f_xla = jax.jit(xla_bundle)
+        g_fused = jax.jit(jax.grad(scalarize(fused_bundle)))
+        g_xla = jax.jit(jax.grad(scalarize(xla_bundle)))
+
+        d64 = np.asarray(data, np.float64)
+        ids_h = np.asarray(ids)
+        mask_h = np.asarray(mask)
+        total64 = np.zeros((n_, f_))
+        count64 = np.zeros(n_)
+        np.add.at(total64, ids_h[mask_h], d64[mask_h])
+        np.add.at(count64, ids_h[mask_h], 1.0)
+        safe64 = np.maximum(count64, 1.0)[:, None]
+        mean64 = total64 / safe64
+        centered = np.where(mask_h[:, None], d64 - mean64[ids_h], 0.0)
+        sumsq64 = np.zeros((n_, f_))
+        np.add.at(sumsq64, ids_h[mask_h], np.square(centered)[mask_h])
+        std64 = np.sqrt(sumsq64 / safe64 + 1e-5)
+        # grad of S = Σ 0.3·total + 1.7·mean − 0.9·std w.r.t. data:
+        per_seg = 0.3 + 1.7 / safe64
+        grad64 = np.where(
+            mask_h[:, None], np.broadcast_to(per_seg[ids_h], (e_, f_)), 0.0
         )
+        quad = np.where(count64[:, None] > 1.0, -0.9 / (std64 * safe64), 0.0)
+        grad64 += np.where(mask_h[:, None], quad[ids_h] * centered, 0.0)
+        truth = (total64, mean64, std64, count64)
 
-    def scalarize(bundle):
-        def fn(d):
-            total, mean, std, count = bundle(d)
-            # All three differentiable outputs contribute to the cotangent.
-            return jnp.sum(total * 0.3 + mean * 1.7 - std * 0.9)
+        def errs(outs, grad):
+            fwd = max(
+                float(np.max(np.abs(np.asarray(o, np.float64) - t)))
+                for o, t in zip(outs, truth)
+            )
+            return fwd, float(np.max(np.abs(np.asarray(grad, np.float64) - grad64)))
 
-        return fn
+        fused_errs = errs(
+            jax.block_until_ready(f_fused(data)), jax.block_until_ready(g_fused(data))
+        )
+        xla_errs = errs(
+            jax.block_until_ready(f_xla(data)), jax.block_until_ready(g_xla(data))
+        )
+        return fused_errs, xla_errs
 
+    data, ids, mask = _problem(e, f, n, seed)
+    (max_err_fwd, max_err_grad), (xla_err_fwd, xla_err_grad) = _accuracy(
+        data, ids, mask, n
+    )
+    # The split=True kernel forks on the packing boundary (2f <= 128 packs
+    # hi/lo into one tile; wider shapes run the two-matmul kernel). Certify
+    # BOTH sides: the flagship f (packed when <= 64) above, and a wide shape
+    # exercising _sum_count_split_kernel here — production takes that path
+    # whenever hidden_dim > 64.
+    f_wide = max(2 * f, 96)
+    wide = _problem(e // 4, f_wide, max(n // 4, _BN), seed + 1)
+    (wide_err_fwd, wide_err_grad), _ = _accuracy(*wide, max(n // 4, _BN))
+
+    fused_bundle, xla_bundle, _ = _bundles(ids, mask, n)
     f_fused = jax.jit(fused_bundle)
     f_xla = jax.jit(xla_bundle)
-    g_fused = jax.jit(jax.grad(scalarize(fused_bundle)))
-    g_xla = jax.jit(jax.grad(scalarize(xla_bundle)))
-
-    # f64 ground truth on host.
-    d64 = np.asarray(data, np.float64)
-    ids_h = np.asarray(ids)
-    mask_h = np.asarray(mask)
-    total64 = np.zeros((n, f))
-    count64 = np.zeros(n)
-    np.add.at(total64, ids_h[mask_h], d64[mask_h])
-    np.add.at(count64, ids_h[mask_h], 1.0)
-    safe64 = np.maximum(count64, 1.0)[:, None]
-    mean64 = total64 / safe64
-    centered = np.where(mask_h[:, None], d64 - mean64[ids_h], 0.0)
-    sumsq64 = np.zeros((n, f))
-    np.add.at(sumsq64, ids_h[mask_h], np.square(centered)[mask_h])
-    std64 = np.sqrt(sumsq64 / safe64 + 1e-5)
-    # grad of S = Σ 0.3·total + 1.7·mean − 0.9·std w.r.t. data:
-    per_seg = 0.3 + 1.7 / safe64
-    grad64 = np.where(
-        mask_h[:, None], np.broadcast_to(per_seg[ids_h], (e, f)), 0.0
-    )
-    quad = np.where(count64[:, None] > 1.0, -0.9 / (std64 * safe64), 0.0)
-    grad64 += np.where(mask_h[:, None], quad[ids_h] * centered, 0.0)
-
-    truth = (total64, mean64, std64, count64)
-
-    def errs(outs, grad):
-        fwd = max(
-            float(np.max(np.abs(np.asarray(o, np.float64) - t)))
-            for o, t in zip(outs, truth)
-        )
-        return fwd, float(np.max(np.abs(np.asarray(grad, np.float64) - grad64)))
-
-    max_err_fwd, max_err_grad = errs(
-        jax.block_until_ready(f_fused(data)), jax.block_until_ready(g_fused(data))
-    )
-    xla_err_fwd, xla_err_grad = errs(
-        jax.block_until_ready(f_xla(data)), jax.block_until_ready(g_xla(data))
-    )
 
     def best_ms(fn):
         times = []
@@ -472,10 +521,13 @@ def certify_pallas(
     return {
         "backend": _platform(),
         "pallas_enabled": pallas_enabled(),
-        "ok": max_err_fwd < tol and max_err_grad < tol,
+        "ok": max(max_err_fwd, max_err_grad, wide_err_fwd, wide_err_grad) < tol,
         "tol": tol,
         "max_err_fwd": max_err_fwd,
         "max_err_grad": max_err_grad,
+        "wide_f": f_wide,
+        "wide_err_fwd": wide_err_fwd,
+        "wide_err_grad": wide_err_grad,
         "xla_err_fwd": xla_err_fwd,
         "xla_err_grad": xla_err_grad,
         "pallas_ms": round(pallas_ms, 4),
@@ -528,12 +580,8 @@ def fused_segment_sum_count(
     ids = segment_ids.astype(jnp.int32)
     if mask is not None:
         ids = jnp.where(mask, ids, -1)
-    # The hi/lo split only buys accuracy when the input has more mantissa
-    # bits than bf16 — for bf16 activations (mixed precision) lo == 0 and the
-    # second matmul would be pure waste.
-    split = flat.dtype != jnp.bfloat16
     total, count = segment_sum_count(
-        flat, ids, num_segments, _platform() != "tpu", split
+        flat, ids, num_segments, _platform() != "tpu", _wants_split(flat.dtype)
     )
     if axis_name is not None:
         total = jax.lax.psum(total, axis_name)

@@ -1,0 +1,348 @@
+"""Reference-checkpoint importer: torch ``.pk`` → flax variables.
+
+The reference saves rank-0 checkpoints as
+``torch.save({"model_state_dict": ..., "optimizer_state_dict": ...}, <name>.pk)``
+(/root/reference/hydragnn/utils/model.py:35-47). This module maps that
+``model_state_dict`` — whose key grammar is fixed by the reference's module
+tree (Base.py:99-223 plus the per-family PyG convs) — onto this framework's
+flax parameter tree, completing the migration story in docs/MIGRATION.md:
+train in the reference, predict here (or keep fine-tuning).
+
+Weight-layout notes (verified in the round-trip test):
+- torch ``Linear.weight`` is [out, in]; flax ``Dense.kernel`` is [in, out] →
+  transposed on import.
+- PyG ``PNAConv`` keeps a separate ``edge_encoder`` Linear ahead of the
+  pre-MLP; our PNAConv fuses it into one Dense over [x_i ‖ x_j ‖ e]. The two
+  are exactly equivalent by linear composition, so the encoder is FOLDED:
+  ``W_edge = W3 @ E_w`` and ``b' = b + W3 @ E_b`` where W3 is the pre-MLP's
+  edge-column block.
+- PyG ``BatchNorm`` wraps a torch BatchNorm1d as ``.module`` → running_mean/
+  running_var land in the ``batch_stats`` collection.
+- The optimizer_state_dict is NOT imported (torch Adam moments have no
+  well-defined mapping onto optax state for a re-designed tree); training
+  resumed here starts with fresh optimizer state.
+
+Structural caveat: for ``num_sharedlayers > 1`` the reference's shared-MLP
+Sequential has no ReLU between its first two Linears (Base.py:155-162 appends
+[ReLU, Linear, Linear, ReLU]); this framework's MLP activates between every
+pair. Weights still transfer 1:1 by Linear order, but forward parity is exact
+only for single-shared-layer configs — flagged in the returned report.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _to_np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy(), dtype=np.float32)
+
+
+def _load_model_state_dict(path: str) -> Dict[str, np.ndarray]:
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    sd = ckpt["model_state_dict"] if "model_state_dict" in ckpt else ckpt
+    # DDP checkpoints prefix every key with "module."
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("module."):
+            k = k[len("module.") :]
+        out[k] = _to_np(v) if hasattr(v, "detach") else np.asarray(v, np.float32)
+    return out
+
+
+def _linears_of_sequential(
+    sd: Dict[str, np.ndarray], prefix: str, consumed: set
+) -> List[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Ordered (kernel, bias) list of the Linears inside a torch Sequential —
+    indices are walked numerically so interleaved ReLUs don't matter."""
+    pat = re.compile(re.escape(prefix) + r"\.(\d+)\.weight$")
+    idxs = sorted(int(m.group(1)) for k in sd if (m := pat.match(k)))
+    out = []
+    for i in idxs:
+        w = sd[f"{prefix}.{i}.weight"]
+        consumed.add(f"{prefix}.{i}.weight")
+        b = sd.get(f"{prefix}.{i}.bias")
+        if b is not None:
+            consumed.add(f"{prefix}.{i}.bias")
+        out.append((w.T, b))
+    return out
+
+
+def _dense(kernel: np.ndarray, bias: Optional[np.ndarray], like: Dict) -> Dict:
+    d = {"kernel": kernel}
+    if "bias" in like:
+        d["bias"] = bias if bias is not None else np.zeros(kernel.shape[1], np.float32)
+    return d
+
+
+def _bn(sd, tprefix: str, consumed: set) -> Tuple[Dict, Dict]:
+    """PyG BatchNorm (`.module.` nesting) or bare BatchNorm1d keys →
+    (params {scale, bias}, batch_stats {mean, var})."""
+    base = tprefix + ".module" if f"{tprefix}.module.weight" in sd else tprefix
+    for suffix in ("weight", "bias", "running_mean", "running_var"):
+        consumed.add(f"{base}.{suffix}")
+    consumed.add(f"{base}.num_batches_tracked")  # harmless if absent
+    return (
+        {"scale": sd[f"{base}.weight"], "bias": sd[f"{base}.bias"]},
+        {"mean": sd[f"{base}.running_mean"], "var": sd[f"{base}.running_var"]},
+    )
+
+
+def _map_conv(
+    family: str, sd, tprefix: str, template: Dict, consumed: set
+) -> Dict:
+    """One PyG conv's tensors → our flax conv module dict (family grammar)."""
+
+    def lin(name, tname=None):
+        tname = tname or name
+        w = sd[f"{tprefix}.{tname}.weight"]
+        consumed.add(f"{tprefix}.{tname}.weight")
+        b = sd.get(f"{tprefix}.{tname}.bias")
+        if b is not None:
+            consumed.add(f"{tprefix}.{tname}.bias")
+        return w, b
+
+    if family == "PNA":
+        # PyG PNAConv towers=1, pre/post_layers=1 (PNAStack.py:40-51):
+        # pre_nns.0.0, post_nns.0.0, lin, optional edge_encoder.
+        pre_w, pre_b = lin("pre", "pre_nns.0.0")
+        post_w, post_b = lin("post", "post_nns.0.0")
+        lin_w, lin_b = lin("lin")
+        f_in = pre_w.shape[0]  # pre-MLP output width == conv input width
+        out = {
+            "post_nn": _dense(post_w.T, post_b, template["post_nn"]),
+            "lin": _dense(lin_w.T, lin_b, template["lin"]),
+        }
+        if f"{tprefix}.edge_encoder.weight" in sd:
+            enc_w, enc_b = lin("enc", "edge_encoder")
+            # pre weight is [F, 3F]: [W_recv | W_send | W3]. Fold the encoder:
+            # pre([xi, xj, Ee+be]) = W_recv xi + W_send xj + (W3 E) e + (b + W3 be)
+            w3 = pre_w[:, 2 * f_in :]
+            kernel = np.concatenate([pre_w[:, : 2 * f_in], w3 @ enc_w], axis=1).T
+            bias = (pre_b if pre_b is not None else 0.0) + (
+                w3 @ enc_b if enc_b is not None else 0.0
+            )
+            out["pre_nn"] = _dense(kernel, np.asarray(bias, np.float32), template["pre_nn"])
+        else:
+            out["pre_nn"] = _dense(pre_w.T, pre_b, template["pre_nn"])
+        return out
+
+    if family == "GIN":
+        # GINStack.py:26-34: nn = Sequential(Linear, ReLU, Linear), train_eps.
+        w0, b0 = lin("m0", "nn.0")
+        w1, b1 = lin("m1", "nn.2")
+        consumed.add(f"{tprefix}.eps")
+        return {
+            "mlp_0": _dense(w0.T, b0, template["mlp_0"]),
+            "mlp_1": _dense(w1.T, b1, template["mlp_1"]),
+            "eps": np.asarray(sd[f"{tprefix}.eps"], np.float32).reshape(()),
+        }
+
+    if family == "SAGE":
+        # PyG SAGEConv: lin_l = neighbor-mean transform (bias), lin_r = root.
+        wl, bl = lin("l", "lin_l")
+        wr, br = lin("r", "lin_r")
+        return {
+            "lin_nbr": _dense(wl.T, bl, template["lin_nbr"]),
+            "lin_self": _dense(wr.T, br, template["lin_self"]),
+        }
+
+    if family == "MFC":
+        # PyG MFConv: per-degree Linear lists — lins_l over the neighbor sum
+        # (carries the bias), lins_r over the root features (bias=False).
+        pat = re.compile(re.escape(tprefix) + r"\.lins_l\.(\d+)\.weight$")
+        degs = sorted(int(m.group(1)) for k in sd if (m := pat.match(k)))
+        w_nbr, w_self, bias = [], [], []
+        for d in degs:
+            wl, bl = lin(f"l{d}", f"lins_l.{d}")
+            wr, _ = lin(f"r{d}", f"lins_r.{d}")
+            w_nbr.append(wl.T)
+            w_self.append(wr.T)
+            bias.append(bl if bl is not None else np.zeros(wl.shape[0], np.float32))
+        return {
+            "w_nbr": np.stack(w_nbr),
+            "w_self": np.stack(w_self),
+            "bias": np.stack(bias),
+        }
+
+    if family == "GAT":
+        # PyG GATv2Conv: lin_l = source transform, lin_r = target, att [1,H,F].
+        wl, bl = lin("l", "lin_l")
+        wr, br = lin("r", "lin_r")
+        consumed.update({f"{tprefix}.att", f"{tprefix}.bias"})
+        att = sd[f"{tprefix}.att"].reshape(template["att"].shape)
+        return {
+            "lin_src": _dense(wl.T, bl, template["lin_src"]),
+            "lin_dst": _dense(wr.T, br, template["lin_dst"]),
+            "att": att,
+            "bias": sd[f"{tprefix}.bias"].reshape(template["bias"].shape),
+        }
+
+    if family == "CGCNN":
+        wf, bf = lin("f", "lin_f")
+        ws, bs = lin("s", "lin_s")
+        return {
+            "lin_f": _dense(wf.T, bf, template["lin_f"]),
+            "lin_s": _dense(ws.T, bs, template["lin_s"]),
+        }
+
+    raise ValueError(f"Unknown conv family {family}")
+
+
+def _map_mlp(sd, tprefix: str, template: Dict, consumed: set) -> Dict:
+    """torch Sequential of Linears(+ReLUs) → our MLP {dense_i} by Linear order."""
+    linears = _linears_of_sequential(sd, tprefix, consumed)
+    dense_names = sorted(
+        (k for k in template if k.startswith("dense_")),
+        key=lambda s: int(s.split("_")[1]),
+    )
+    if len(linears) != len(dense_names):
+        raise ValueError(
+            f"{tprefix}: {len(linears)} torch Linears vs "
+            f"{len(dense_names)} flax Dense layers"
+        )
+    return {
+        name: _dense(k, b, template[name])
+        for name, (k, b) in zip(dense_names, linears)
+    }
+
+
+def import_torch_checkpoint(
+    path: str, model, variables: Dict[str, Any]
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Map a reference HydraGNN torch checkpoint onto ``variables``.
+
+    ``model`` is the flax HydraGNN built by ``create_model`` with the SAME
+    architecture config the torch checkpoint was trained with; ``variables``
+    its initialized variables (shape template). Returns ``(new_variables,
+    report)`` where report lists consumed/ignored torch keys and any caveats.
+    Every imported array is shape-checked against the template; a mismatch
+    means the configs differ and raises.
+    """
+    import jax
+
+    sd = _load_model_state_dict(path)
+    consumed: set = set()
+    params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
+    stats = jax.tree_util.tree_map(
+        np.asarray, dict(variables.get("batch_stats", {}))
+    )
+    caveats: List[str] = []
+    family = model.conv_type
+
+    # --- encoder convs + batch norms (Base._init_conv) ---
+    n_convs = len([k for k in params if re.fullmatch(r"conv_\d+", k)])
+    for i in range(n_convs):
+        params[f"conv_{i}"] = _map_conv(
+            family, sd, f"convs.{i}", params[f"conv_{i}"], consumed
+        )
+        p, s = _bn(sd, f"batch_norms.{i}", consumed)
+        params[f"bn_{i}"] = p
+        stats[f"bn_{i}"] = s
+
+    # --- node-head conv chains (Base._init_node_conv; the reference ALSO
+    # aliases these modules under heads_NN.*, which we ignore as duplicates) ---
+    for ours, theirs in (
+        ("node_conv_", "convs_node_hidden."),
+        ("node_out_conv_", "convs_node_output."),
+    ):
+        for k in [k for k in params if k.startswith(ours)]:
+            i = int(k.rsplit("_", 1)[1])
+            params[k] = _map_conv(family, sd, f"{theirs}{i}", params[k], consumed)
+    for ours, theirs in (
+        ("node_bn_", "batch_norms_node_hidden."),
+        ("node_out_bn_", "batch_norms_node_output."),
+    ):
+        for k in [k for k in params if k.startswith(ours)]:
+            i = int(k.rsplit("_", 1)[1])
+            p, s = _bn(sd, f"{theirs}{i}", consumed)
+            params[k] = p
+            stats[k] = s
+    # Conv-type node heads: the reference appends the SAME conv/bn module
+    # objects to heads_NN (Base.py:209-216), so their tensors appear twice in
+    # the state_dict (convs_node_* and heads_NN.{i}.{j}.*). The former were
+    # imported above; mark the aliases consumed so they don't read as ignored.
+    for ihead, htype in enumerate(model.output_type):
+        if htype == "node" and f"head_{ihead}" not in params:
+            consumed.update(
+                k for k in sd if k.startswith(f"heads_NN.{ihead}.")
+            )
+
+    # --- graph shared MLP (Base._multihead, Base.py:155-162) ---
+    if "graph_shared" in params:
+        params["graph_shared"] = _map_mlp(
+            sd, "graph_shared", params["graph_shared"], consumed
+        )
+        n_shared = len(params["graph_shared"])
+        if n_shared > 1:
+            caveats.append(
+                "num_sharedlayers > 1: reference Sequential lacks the "
+                "inter-Linear ReLU this framework applies — weights "
+                "transferred 1:1 but forward outputs will differ"
+            )
+
+    # --- per-head MLPs ---
+    for ihead, htype in enumerate(model.output_type):
+        key = f"head_{ihead}"
+        if key not in params:
+            continue  # conv node heads live in node_conv_* above
+        tprefix = f"heads_NN.{ihead}"
+        if htype == "graph":
+            params[key] = _map_mlp(sd, tprefix, params[key], consumed)
+        elif "mlp" in params[key]:  # node 'mlp': shared MLPNode → mlp.0
+            params[key] = {
+                "mlp": _map_mlp(sd, f"{tprefix}.mlp.0", params[key]["mlp"], consumed)
+            }
+        else:  # node 'mlp_per_node': one Sequential per node slot
+            tmpl = params[key]
+            n_layers = len([k for k in tmpl if k.startswith("w_")])
+            num_nodes = tmpl["w_0"].shape[0]
+            per_node = [
+                _linears_of_sequential(sd, f"{tprefix}.mlp.{inode}", consumed)
+                for inode in range(num_nodes)
+            ]
+            new = {}
+            for li in range(n_layers):
+                new[f"w_{li}"] = np.stack([pn[li][0] for pn in per_node])
+                new[f"b_{li}"] = np.stack(
+                    [
+                        pn[li][1]
+                        if pn[li][1] is not None
+                        else np.zeros(pn[li][0].shape[1], np.float32)
+                        for pn in per_node
+                    ]
+                )
+            params[key] = new
+
+    # --- shape-check against the template and freeze dtypes ---
+    flat_new = jax.tree_util.tree_leaves_with_path(params)
+    flat_tmpl = dict(jax.tree_util.tree_leaves_with_path(variables["params"]))
+    for path_k, leaf in flat_new:
+        tmpl_leaf = flat_tmpl.get(path_k)
+        if tmpl_leaf is None:
+            raise ValueError(f"imported leaf {path_k} not in template tree")
+        if tuple(np.shape(leaf)) != tuple(np.shape(tmpl_leaf)):
+            raise ValueError(
+                f"shape mismatch at {jax.tree_util.keystr(path_k)}: "
+                f"checkpoint {np.shape(leaf)} vs model {np.shape(tmpl_leaf)} "
+                "— architecture configs differ"
+            )
+    if len(flat_new) != len(flat_tmpl):
+        missing = set(flat_tmpl) - {p for p, _ in flat_new}
+        raise ValueError(f"unfilled parameter leaves: {sorted(map(str, missing))}")
+
+    ignored = sorted(k for k in sd if k not in consumed)
+    new_vars = dict(variables)
+    new_vars["params"] = params
+    if stats:
+        new_vars["batch_stats"] = stats
+    return new_vars, {
+        "consumed": sorted(consumed & set(sd)),
+        "ignored": ignored,
+        "caveats": caveats,
+    }

@@ -75,9 +75,29 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
         # unique per launch) so a stale sentinel from an earlier run can't
         # release waiting ranks early.
         run_id = os.environ.get("MASTER_PORT", "serial")
+        def _dir_state(path):
+            """Fingerprint of the generated dataset: sorted (name, size)
+            pairs. A partially written file has a different size, so a match
+            means the directory is byte-complete."""
+            try:
+                entries = sorted(
+                    (n, os.path.getsize(os.path.join(path, n)))
+                    for n in os.listdir(path)
+                )
+            except OSError:
+                return None
+            return repr(entries) if entries else None
+
         for dataset_name, data_path in config["Dataset"]["path"].items():
             sentinel = data_path.rstrip("/") + f".done.{run_id}"
             if world_rank == 0:
+                # Remove any sentinel left by a previous launch that reused
+                # this port. Waiting ranks additionally validate the sentinel
+                # CONTENT against the live directory state below, so even a
+                # stale sentinel read before this removal cannot release them
+                # against an incomplete dataset.
+                if os.path.exists(sentinel):
+                    os.remove(sentinel)
                 num_samples = {
                     "total": num_samples_tot,
                     "train": int(num_samples_tot * perc_train),
@@ -89,11 +109,21 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
                     deterministic_graph_data(
                         data_path, number_configurations=num_samples
                     )
-                with open(sentinel, "w"):
-                    pass
+                with open(sentinel, "w") as f:
+                    f.write(_dir_state(data_path) or "")
             else:
                 deadline = _time.time() + 300
-                while not os.path.exists(sentinel):
+                while True:
+                    # Release only when the recorded fingerprint matches the
+                    # directory RIGHT NOW — a stale sentinel (same port, dir
+                    # since cleared/regenerating) cannot match mid-generation.
+                    try:
+                        with open(sentinel) as f:
+                            recorded = f.read()
+                    except OSError:
+                        recorded = None
+                    if recorded and recorded == _dir_state(data_path):
+                        break
                     if _time.time() > deadline:
                         raise TimeoutError(f"rank 0 never finished {data_path}")
                     _time.sleep(0.1)

@@ -216,3 +216,20 @@ def pytest_fused_ops_differentiable_under_shard_map(monkeypatch):
     )
     g = jax.grad(lambda l: f(l, ids))(logits)
     assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def pytest_packed_split_boundary_matches_unpacked():
+    """The f-packed split path (2f <= 128: hi/lo share one 128-lane tile and
+    one matmul) must agree with the two-matmul split path across the packing
+    boundary — f=64 packs, f=65 cannot."""
+    rng = np.random.default_rng(11)
+    for f in (1, 64, 65, 128):
+        data = jnp.asarray(rng.normal(size=(300, f)).astype(np.float32) * 3.0)
+        ids = jnp.asarray(rng.integers(0, 40, size=300).astype(np.int32))
+        s_split, c_split = ps.segment_sum_count(data, ids, 40, True, split=True)
+        ref = seg.segment_sum(data, ids, 40)
+        # (In the CPU interpreter the matmul is already exact f32, so only
+        # parity — not accuracy ordering vs split=False — is checkable here;
+        # certify_pallas measures the real-bf16 accuracy on TPU.)
+        np.testing.assert_allclose(s_split, ref, rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(c_split, seg.segment_count(ids, 40), rtol=1e-6)
