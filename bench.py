@@ -333,6 +333,29 @@ def main():
         result["error"] = f"{type(e).__name__}: {e}"
         result["trace_tail"] = traceback.format_exc()[-1500:]
         result["retries"] = _RETRIES_USED
+        if isinstance(e, TimeoutError):
+            # Dead accelerator tunnel: self-document the dated probe failure
+            # so a missing perf artifact is provably environmental.
+            try:
+                with open(
+                    os.path.join(os.path.dirname(__file__), "TPU_PROBES.jsonl"),
+                    "a",
+                ) as f:
+                    rec = {
+                        "ts_unix": time.time(),
+                        "ts_utc": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                        "probe": "bench.py _probe_device",
+                        "result": "hang",
+                        "detail": str(e),
+                        "retries": _RETRIES_USED,
+                    }
+                    if os.environ.get("HYDRAGNN_ROUND"):
+                        rec["round"] = int(os.environ["HYDRAGNN_ROUND"])
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
         print(json.dumps(result))
         sys.exit(1)
     result["retries"] = _RETRIES_USED

@@ -1,0 +1,168 @@
+"""Feed-vs-train profile of the PRODUCTION bucketed path (VERDICT r03 item 8).
+
+Answers "is the pipeline input-bound at batch 256?" with two independent
+measurements over ci_multihead.json + bucketed GraphDataLoader + the
+TrainingDriver scan epochs (the same plumbing bench.py's production workload
+times):
+
+1. ablation: steady-epoch wall time with the REAL loader vs with the same
+   batches pre-materialized in memory (zero feed cost). The difference is the
+   true feed overhead — robust under async dispatch, where span timings lie.
+2. spans: one epoch through the per-step path with a timing profiler stub
+   counting "feed" (prefetcher queue wait + lift) vs "train_step" (dispatch)
+   wall time — the same spans a real jax.profiler trace annotates.
+
+Optionally captures a jax.profiler trace of one steady epoch (--trace) for
+TensorBoard/Perfetto. Writes a JSON artifact (--out, e.g. PROFILE_r04.json).
+
+Usage: python benchmarks/profile_epoch.py [--platform cpu|axon] [--batch 256]
+       [--epochs 4] [--trace] [--out PROFILE_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+class _TimingSpans:
+    """Profiler stand-in for TrainingDriver.train_epoch: accumulates wall
+    time per annotation name. ``active=True`` routes the driver onto the
+    per-step path (the scan path hides step boundaries)."""
+
+    active = True
+
+    def __init__(self):
+        self.acc = {}
+
+    @contextlib.contextmanager
+    def annotate(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.acc[name] = self.acc.get(name, 0.0) + time.perf_counter() - t0
+
+    def step(self):
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=4, help="steady epochs per arm")
+    ap.add_argument("--trace", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from hydragnn_tpu.models.create import create_model_config, init_model_variables
+    from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
+    from hydragnn_tpu.train.train_validate_test import TrainingDriver
+    from hydragnn_tpu.train.trainer import create_train_state
+    from hydragnn_tpu.utils.config_utils import update_config
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    os.environ.setdefault("SERIALIZED_DATA_PATH", REPO)
+    with open(os.path.join(REPO, "tests/inputs/ci_multihead.json")) as f:
+        config = json.load(f)
+    for split in list(config["Dataset"]["path"]):
+        suffix = "" if split == "total" else "_" + split
+        pkl = os.path.join(
+            os.environ["SERIALIZED_DATA_PATH"],
+            "serialized_dataset",
+            config["Dataset"]["name"] + suffix + ".pkl",
+        )
+        if os.path.exists(pkl):
+            config["Dataset"]["path"][split] = pkl
+    config["Dataset"]["num_buckets"] = 2
+    config["NeuralNetwork"]["Training"]["batch_size"] = args.batch
+
+    train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
+        config=config
+    )
+    config = update_config(config, train_loader, val_loader, test_loader)
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+
+    model = create_model_config(config=arch, verbosity=0)
+    variables = init_model_variables(model, next(iter(train_loader)))
+    opt = select_optimizer(training["optimizer"], training["learning_rate"])
+    state = create_train_state(model, variables, opt)
+    driver = TrainingDriver(model, opt, state)
+
+    # Compile epoch (both paths get warmed: scan epoch now, per-step below).
+    train_loader.set_epoch(0)
+    t0 = time.perf_counter()
+    driver.train_epoch(train_loader)
+    compile_s = time.perf_counter() - t0
+
+    # Arm 1a: real loader (feed included).
+    t0 = time.perf_counter()
+    for e in range(args.epochs):
+        train_loader.set_epoch(e + 1)
+        driver.train_epoch(train_loader)
+    real_s = (time.perf_counter() - t0) / args.epochs
+
+    # Arm 1b: identical batches pre-materialized (zero feed cost). The epoch
+    # consumed is the last real epoch's batch sequence, so shapes and chunk
+    # boundaries match the scan-path caches exactly.
+    cached = list(train_loader)
+    t0 = time.perf_counter()
+    for _ in range(args.epochs):
+        driver.train_epoch(cached)
+    cached_s = (time.perf_counter() - t0) / args.epochs
+
+    # Arm 2: span timings through the per-step path. The scan-path warmup
+    # above compiled only epoch_scan; the per-step train_step is a separate
+    # jit, so run one discarded per-step epoch first or its compile would
+    # land inside the measured "train_step" span.
+    driver.train_epoch(train_loader, profiler=_TimingSpans())
+    spans = _TimingSpans()
+    driver.train_epoch(train_loader, profiler=spans)
+
+    trace_dir = None
+    if args.trace:
+        trace_dir = os.path.join(REPO, "logs", "profile_epoch", "profiler_output")
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        driver.train_epoch(train_loader)
+        jax.profiler.stop_trace()
+
+    n_graphs = len(train_loader.dataset)
+    feed_overhead = max(0.0, 1.0 - cached_s / real_s)
+    result = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": args.batch,
+        "train_graphs": n_graphs,
+        "compile_epoch_s": round(compile_s, 3),
+        "steady_epoch_s_real_feed": round(real_s, 4),
+        "steady_epoch_s_cached_feed": round(cached_s, 4),
+        "feed_overhead_share": round(feed_overhead, 4),
+        "graphs_per_sec_production": round(n_graphs / real_s, 1),
+        "span_feed_wait_s": round(spans.acc.get("feed", 0.0), 4),
+        "span_train_dispatch_s": round(spans.acc.get("train_step", 0.0), 4),
+        "trace_dir": trace_dir,
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,12 @@ def main():
         "long-context analog axis); the data axis still sweeps 1,2,4,... "
         "so each line uses data_axis*graph_axis devices",
     )
+    ap.add_argument(
+        "--out", default=None,
+        help="also append this sweep as ONE JSON line to an artifact file "
+        "(per-round scaling provenance, e.g. SCALING_r04.jsonl; append-only "
+        "so an interrupted write cannot lose prior sweeps)",
+    )
     args = ap.parse_args()
 
     if args.cpu:
@@ -81,6 +87,7 @@ def main():
 
     rng = np.random.default_rng(0)
     base = None
+    rows = []
     for d in sizes:
         mesh = make_mesh(data_axis=d, graph_axis=ga)
         # Edge arrays are sharded over the graph axis: round the pad up to a
@@ -119,18 +126,27 @@ def main():
         gps = PER_DEV_BATCH * d * STEPS / el
         if base is None:
             base = gps
-        print(
-            json.dumps(
-                {
-                    "devices": d * ga,
-                    "mesh": f"data:{d}xgraph:{ga}",
-                    "graphs_per_sec": round(gps, 1),
-                    "per_device": round(gps / (d * ga), 1),
-                    "efficiency": round(gps / (d * base), 3),
-                }
-            ),
-            flush=True,
-        )
+        row = {
+            "devices": d * ga,
+            "mesh": f"data:{d}xgraph:{ga}",
+            "graphs_per_sec": round(gps, 1),
+            "per_device": round(gps / (d * ga), 1),
+            "efficiency": round(gps / (d * base), 3),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.out:
+        entry = {
+            "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "platform": jax.default_backend(),
+            "per_device_batch": PER_DEV_BATCH,
+            "hidden": args.hidden,
+            "layers": args.layers,
+            "sweep": rows,
+        }
+        with open(args.out, "a") as f:
+            f.write(json.dumps(entry) + "\n")
 
 
 if __name__ == "__main__":

@@ -50,7 +50,11 @@ def pytest_collection_modifyitems(config, items):
     # end-to-end suites run (the high-level API auto-shards over the global
     # mesh); distributed unit coverage lives in tests/test_distributed.py and
     # the rendezvous harness in tests/test_multiprocess.py.
-    world_safe = {"test_graphs.py"}
+    # World-safe = the whole flow rides the high-level API (auto-sharding over
+    # the global mesh, rank-0 file writes behind barriers): the convergence
+    # matrix AND checkpoint-reload/predict (train → save → fresh model →
+    # load_existing_model → evaluate under 2 ranks).
+    world_safe = {"test_graphs.py", "test_model_loadpred.py"}
     skip_local = pytest.mark.skip(
         reason="single-process test (local virtual mesh) under multi-process run"
     )

@@ -32,8 +32,28 @@ def _free_port() -> int:
 
 
 def main() -> int:
+    import time
+
+    # Per-round provenance artifact ({passed, skipped, seconds, rc} per rank)
+    # so suite regressions are mechanically visible, not only in stray logs.
+    artifact = None
+    argv = sys.argv[1:]
+    if "--artifact" in argv:
+        i = argv.index("--artifact")
+        if i + 1 >= len(argv):
+            sys.exit("usage: run_suite_2proc.py [--artifact PATH] [pytest args...]")
+        artifact = argv[i + 1]
+        argv = argv[:i] + argv[i + 2 :]
+    else:
+        for a in argv:
+            if a.startswith("--artifact="):
+                artifact = a.split("=", 1)[1]
+                argv = [x for x in argv if x != a]
+                break
+
     port = _free_port()
-    extra = sys.argv[1:] or ["tests/"]
+    extra = argv or ["tests/"]
+    t_start = time.time()
     procs = []
     logs = []
     for rank in range(2):
@@ -61,15 +81,43 @@ def main() -> int:
             )
         )
     rcs = [p.wait() for p in procs]
+    elapsed = round(time.time() - t_start, 1)
     ran = []
-    for path, log in logs:
+    per_rank = []
+    for rank, (path, log) in enumerate(logs):
         log.close()
         with open(path) as f:
             text = f.read()
         m = re.search(r"(\d+) passed", text)
+        skipped = re.search(r"(\d+) skipped", text)
         ran.append(int(m.group(1)) if m else 0)
+        per_rank.append(
+            {
+                "rank": rank,
+                "passed": ran[-1],
+                "skipped": int(skipped.group(1)) if skipped else 0,
+                "rc": rcs[rank],
+            }
+        )
     sys.stdout.write(open(logs[0][0]).read())
     print(f"rank return codes: {rcs}; tests passed per rank: {ran}")
+    if artifact:
+        import json
+
+        with open(artifact, "w") as f:
+            json.dump(
+                {
+                    "ts_utc": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(t_start)
+                    ),
+                    "seconds": elapsed,
+                    "selection": extra,
+                    "ranks": per_rank,
+                    "ok": all(rc == 0 for rc in rcs) and all(n > 0 for n in ran),
+                },
+                f,
+                indent=2,
+            )
     if not all(n > 0 for n in ran):
         # All-skipped still exits 0 from pytest; a selection outside the
         # multi-process-safe set must not read as a green distributed run.

@@ -9,7 +9,7 @@ import random
 import sys
 
 import numpy as np
-import pytest
+
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -80,7 +80,6 @@ def unittest_model_prediction(config):
         )
 
 
-@pytest.mark.mpi_skip()
 def pytest_model_loadpred():
     model_type = "PNA"
     config_file = os.path.join(os.getcwd(), "tests/inputs", "ci_multihead.json")
