@@ -147,21 +147,18 @@ def _peak_workload():
     }
 
 
-def _production_workload():
+def build_production_pipeline(batch_size: "int | None" = None) -> dict:
     """ci_multihead.json (the north-star multi-task config) through the real
     pipeline: serialized dataset -> bucketed loader (2 shape buckets) ->
-    TrainingDriver scan epochs + plateau scheduler -> test-split accuracy."""
+    config completion -> model -> TrainingDriver. ONE implementation shared
+    by the production workload below and benchmarks/profile_epoch.py, so the
+    profiler measures exactly the plumbing the benchmark times."""
     from hydragnn_tpu.models.create import create_model_config, init_model_variables
     from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
     from hydragnn_tpu.train.train_validate_test import TrainingDriver
     from hydragnn_tpu.train.trainer import create_train_state
     from hydragnn_tpu.utils.config_utils import update_config
-    from hydragnn_tpu.utils.optimizer import (
-        ReduceLROnPlateau,
-        get_learning_rate,
-        select_optimizer,
-        set_learning_rate,
-    )
+    from hydragnn_tpu.utils.optimizer import select_optimizer
 
     repo = os.path.dirname(os.path.abspath(__file__))
     os.environ.setdefault("SERIALIZED_DATA_PATH", repo)
@@ -178,6 +175,8 @@ def _production_workload():
             config["Dataset"]["path"][split] = pkl
     # Production bucketing plumbing: two shape buckets over the train split.
     config["Dataset"]["num_buckets"] = 2
+    if batch_size is not None:
+        config["NeuralNetwork"]["Training"]["batch_size"] = batch_size
 
     train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
         config=config
@@ -185,13 +184,39 @@ def _production_workload():
     config = update_config(config, train_loader, val_loader, test_loader)
     arch = config["NeuralNetwork"]["Architecture"]
     training = config["NeuralNetwork"]["Training"]
-    bucketed = train_loader
 
     model = create_model_config(config=arch, verbosity=0)
-    variables = init_model_variables(model, next(iter(bucketed)))
+    variables = init_model_variables(model, next(iter(train_loader)))
     opt = select_optimizer(training["optimizer"], training["learning_rate"])
     state = create_train_state(model, variables, opt)
     driver = TrainingDriver(model, opt, state)
+    return {
+        "config": config,
+        "train_loader": train_loader,
+        "val_loader": val_loader,
+        "test_loader": test_loader,
+        "model": model,
+        "driver": driver,
+    }
+
+
+def _production_workload():
+    """Production pipeline -> scan epochs + plateau scheduler -> test-split
+    accuracy."""
+    from hydragnn_tpu.utils.optimizer import (
+        ReduceLROnPlateau,
+        get_learning_rate,
+        set_learning_rate,
+    )
+
+    pipe = build_production_pipeline()
+    config = pipe["config"]
+    val_loader = pipe["val_loader"]
+    test_loader = pipe["test_loader"]
+    driver = pipe["driver"]
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+    bucketed = pipe["train_loader"]
     scheduler = ReduceLROnPlateau(factor=0.5, patience=5, min_lr=1e-5)
 
     num_epoch = training["num_epoch"]
@@ -351,7 +376,7 @@ def main():
                         "detail": str(e),
                         "retries": _RETRIES_USED,
                     }
-                    if os.environ.get("HYDRAGNN_ROUND"):
+                    if os.environ.get("HYDRAGNN_ROUND", "").isdigit():
                         rec["round"] = int(os.environ["HYDRAGNN_ROUND"])
                     f.write(json.dumps(rec) + "\n")
             except OSError:

@@ -68,40 +68,13 @@ def main():
     if args.platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    from hydragnn_tpu.models.create import create_model_config, init_model_variables
-    from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
-    from hydragnn_tpu.train.train_validate_test import TrainingDriver
-    from hydragnn_tpu.train.trainer import create_train_state
-    from hydragnn_tpu.utils.config_utils import update_config
-    from hydragnn_tpu.utils.optimizer import select_optimizer
+    # The ONE production-pipeline constructor, shared with bench.py so the
+    # profiler measures exactly the plumbing the benchmark times.
+    from bench import build_production_pipeline
 
-    os.environ.setdefault("SERIALIZED_DATA_PATH", REPO)
-    with open(os.path.join(REPO, "tests/inputs/ci_multihead.json")) as f:
-        config = json.load(f)
-    for split in list(config["Dataset"]["path"]):
-        suffix = "" if split == "total" else "_" + split
-        pkl = os.path.join(
-            os.environ["SERIALIZED_DATA_PATH"],
-            "serialized_dataset",
-            config["Dataset"]["name"] + suffix + ".pkl",
-        )
-        if os.path.exists(pkl):
-            config["Dataset"]["path"][split] = pkl
-    config["Dataset"]["num_buckets"] = 2
-    config["NeuralNetwork"]["Training"]["batch_size"] = args.batch
-
-    train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
-        config=config
-    )
-    config = update_config(config, train_loader, val_loader, test_loader)
-    arch = config["NeuralNetwork"]["Architecture"]
-    training = config["NeuralNetwork"]["Training"]
-
-    model = create_model_config(config=arch, verbosity=0)
-    variables = init_model_variables(model, next(iter(train_loader)))
-    opt = select_optimizer(training["optimizer"], training["learning_rate"])
-    state = create_train_state(model, variables, opt)
-    driver = TrainingDriver(model, opt, state)
+    pipe = build_production_pipeline(batch_size=args.batch)
+    train_loader = pipe["train_loader"]
+    driver = pipe["driver"]
 
     # Compile epoch (both paths get warmed: scan epoch now, per-step below).
     train_loader.set_epoch(0)

@@ -1,0 +1,107 @@
+"""Edge-block-size sweep for the fused Pallas segment kernel.
+
+The kernel's grid walks edge blocks of _BE columns (ops/pallas_segment.py);
+larger blocks amortize grid overhead, smaller ones cut VMEM residency. The
+right value is a hardware measurement, not a guess — this sweep re-runs
+``certify_pallas`` (accuracy + timed sum/mean/std bundle vs the XLA path) for
+each candidate in a FRESH subprocess (the module pins _BE at import from
+HYDRAGNN_PALLAS_BE) and appends the winner to a JSONL artifact.
+
+Run ON TPU (the CPU interpreter's timings are meaningless for block tuning):
+
+    JAX_PLATFORMS=axon python benchmarks/tune_kernel.py --out TUNE_KERNEL_r04.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_CHILD = r"""
+import json, os, sys
+if os.environ.get("HYDRAGNN_TUNE_CPU"):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+from hydragnn_tpu.ops.pallas_segment import certify_pallas, _BE
+r = certify_pallas(e=int(sys.argv[1]), f=int(sys.argv[2]), n=int(sys.argv[3]))
+r["be"] = _BE
+print("RESULT " + json.dumps(r))
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--candidates", default="256,512,1024,2048")
+    ap.add_argument("--e", type=int, default=16384)
+    ap.add_argument("--f", type=int, default=64)
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="force the CPU interpreter in children (plumbing smoke test "
+        "only — timings are meaningless off-TPU)",
+    )
+    args = ap.parse_args()
+
+    rows = []
+    for be in (int(x) for x in args.candidates.split(",")):
+        env = dict(os.environ, HYDRAGNN_PALLAS_BE=str(be), HYDRAGNN_PALLAS="1")
+        if args.cpu:
+            env["HYDRAGNN_TUNE_CPU"] = "1"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD, str(args.e), str(args.f), str(args.n)],
+                cwd=REPO,
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=900,
+            )
+        except subprocess.TimeoutExpired:
+            # Dead accelerator tunnel hangs the child (TPU_PROBES.jsonl
+            # failure mode): record the row and keep sweeping.
+            rows.append({"be": be, "error": "child timed out after 900s"})
+            print(json.dumps(rows[-1]), flush=True)
+            continue
+        line = next(
+            (l for l in proc.stdout.splitlines() if l.startswith("RESULT ")), None
+        )
+        if line is None:
+            rows.append({"be": be, "error": (proc.stderr or proc.stdout)[-300:]})
+            print(json.dumps(rows[-1]), flush=True)
+            continue
+        r = json.loads(line[len("RESULT ") :])
+        rows.append(
+            {
+                "be": be,
+                "ok": r["ok"],
+                "pallas_ms": r["pallas_ms"],
+                "xla_ms": r["xla_ms"],
+                "speedup": r["speedup"],
+                "backend": r["backend"],
+            }
+        )
+        print(json.dumps(rows[-1]), flush=True)
+
+    timed = [r for r in rows if r.get("ok")]
+    best = min(timed, key=lambda r: r["pallas_ms"]) if timed else None
+    summary = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "workload": {"e": args.e, "f": args.f, "n": args.n},
+        "rows": rows,
+        "best_be": best and best["be"],
+    }
+    print(json.dumps({"best_be": summary["best_be"]}))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(summary) + "\n")
+
+
+if __name__ == "__main__":
+    main()

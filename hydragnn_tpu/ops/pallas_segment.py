@@ -55,7 +55,20 @@ import jax.numpy as jnp
 from . import segment as seg
 
 _BN = 128  # node-block rows (one MXU tile edge)
-_BE = 512  # edge-block columns per grid step
+# Edge-block columns per grid step. Env-overridable (HYDRAGNN_PALLAS_BE) so
+# benchmarks/tune_kernel.py can sweep block sizes on hardware without code
+# edits; must be a multiple of 128 (lane count).
+try:
+    _BE = int(os.environ.get("HYDRAGNN_PALLAS_BE", "512"))
+except ValueError:
+    raise ValueError(
+        "HYDRAGNN_PALLAS_BE must be an integer multiple of 128, got "
+        f"{os.environ['HYDRAGNN_PALLAS_BE']!r}"
+    ) from None
+if _BE <= 0 or _BE % 128 != 0:
+    raise ValueError(
+        f"HYDRAGNN_PALLAS_BE={_BE} must be a positive multiple of 128 (lanes)"
+    )
 
 # Platform the gating decisions see. jax.default_backend() is a process-global
 # property and is WRONG in mixed-platform environments (e.g. a TPU-attached

@@ -32,24 +32,15 @@ def _free_port() -> int:
 
 
 def main() -> int:
+    import argparse
     import time
 
     # Per-round provenance artifact ({passed, skipped, seconds, rc} per rank)
     # so suite regressions are mechanically visible, not only in stray logs.
-    artifact = None
-    argv = sys.argv[1:]
-    if "--artifact" in argv:
-        i = argv.index("--artifact")
-        if i + 1 >= len(argv):
-            sys.exit("usage: run_suite_2proc.py [--artifact PATH] [pytest args...]")
-        artifact = argv[i + 1]
-        argv = argv[:i] + argv[i + 2 :]
-    else:
-        for a in argv:
-            if a.startswith("--artifact="):
-                artifact = a.split("=", 1)[1]
-                argv = [x for x in argv if x != a]
-                break
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--artifact", default=None)
+    args, argv = ap.parse_known_args()
+    artifact = args.artifact
 
     port = _free_port()
     extra = argv or ["tests/"]

@@ -233,3 +233,36 @@ def pytest_packed_split_boundary_matches_unpacked():
         # certify_pallas measures the real-bf16 accuracy on TPU.)
         np.testing.assert_allclose(s_split, ref, rtol=1e-6, atol=1e-5)
         np.testing.assert_allclose(c_split, seg.segment_count(ids, 40), rtol=1e-6)
+
+
+def pytest_be_override_parity(monkeypatch):
+    """HYDRAGNN_PALLAS_BE resizes the kernel's edge block at import time
+    (benchmarks/tune_kernel.py sweeps it on hardware); any multiple of 128
+    must give identical results."""
+    import importlib
+
+    rng = np.random.default_rng(13)
+    data = jnp.asarray(rng.normal(size=(700, 9)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 50, size=700).astype(np.int32))
+    want = seg.segment_sum(data, ids, 50)
+
+    import os
+
+    ambient = os.environ.get("HYDRAGNN_PALLAS_BE")
+    monkeypatch.setenv("HYDRAGNN_PALLAS_BE", "256")
+    importlib.reload(ps)
+    try:
+        assert ps._BE == 256
+        s, c = ps.segment_sum_count(data, ids, 50, True)
+        np.testing.assert_allclose(s, want, rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(c, seg.segment_count(ids, 50), rtol=1e-6)
+    finally:
+        # Restore the AMBIENT env (monkeypatch teardown will do the same for
+        # os.environ — the reload must happen under that value or module
+        # state and environment diverge for the rest of the session).
+        if ambient is None:
+            monkeypatch.delenv("HYDRAGNN_PALLAS_BE")
+        else:
+            monkeypatch.setenv("HYDRAGNN_PALLAS_BE", ambient)
+        importlib.reload(ps)
+    assert ps._BE == (int(ambient) if ambient else 512)
