@@ -49,8 +49,15 @@ def main():
     )
     args = ap.parse_args()
 
+    try:
+        candidates = [int(x) for x in args.candidates.split(",") if x.strip()]
+    except ValueError:
+        sys.exit(f"--candidates must be comma-separated integers, got {args.candidates!r}")
+    if not candidates:
+        sys.exit("--candidates is empty")
+
     rows = []
-    for be in (int(x) for x in args.candidates.split(",")):
+    for be in candidates:
         env = dict(os.environ, HYDRAGNN_PALLAS_BE=str(be), HYDRAGNN_PALLAS="1")
         if args.cpu:
             env["HYDRAGNN_TUNE_CPU"] = "1"

@@ -37,7 +37,9 @@ def main() -> int:
 
     # Per-round provenance artifact ({passed, skipped, seconds, rc} per rank)
     # so suite regressions are mechanically visible, not only in stray logs.
-    ap = argparse.ArgumentParser(add_help=False)
+    # allow_abbrev=False: unknown args forward to pytest verbatim — a prefix
+    # like --art must not be swallowed as an abbreviation of --artifact.
+    ap = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
     ap.add_argument("--artifact", default=None)
     args, argv = ap.parse_known_args()
     artifact = args.artifact

@@ -7,6 +7,7 @@ it with torch.save the way save_model does
 the edge-encoder fold (functional check in numpy), and a full forward pass."""
 
 import collections
+import os
 
 import numpy as np
 import pytest
@@ -323,3 +324,150 @@ def pytest_torch_import_other_families(family, tmp_path):
     assert report["ignored"] == [], (family, report["ignored"])
     out = model.apply(new_vars, batch, train=False)
     assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+def pytest_torch_import_conv_node_head(tmp_path):
+    """'conv' node heads: tensors live under convs_node_* / batch_norms_node_*
+    and are ALSO aliased under heads_NN.{i}.{j} (the reference appends the
+    same module objects, Base.py:209-216) — aliases must read as consumed."""
+    gen = np.random.default_rng(8)
+    h0, h1 = 6, 5
+    sd = collections.OrderedDict()
+
+    def put(prefix, tensors):
+        for k, v in tensors.items():
+            sd[f"{prefix}.{k}"] = v
+
+    def bn(prefix, w):
+        sd[f"{prefix}.module.weight"] = torch.ones(w)
+        sd[f"{prefix}.module.bias"] = torch.zeros(w)
+        sd[f"{prefix}.module.running_mean"] = torch.zeros(w)
+        sd[f"{prefix}.module.running_var"] = torch.ones(w)
+        sd[f"{prefix}.module.num_batches_tracked"] = torch.tensor(1)
+
+    def gin(prefix, f_in, f_out):
+        put(f"{prefix}.nn.0", _lin(gen, f_out, f_in))
+        put(f"{prefix}.nn.2", _lin(gen, f_out, f_out))
+        sd[f"{prefix}.eps"] = torch.tensor([3.0])
+
+    # encoder: 2 GIN convs
+    gin("convs.0", IN, HID)
+    bn("batch_norms.0", HID)
+    gin("convs.1", HID, HID)
+    bn("batch_norms.1", HID)
+    # node-conv chain: 2 hidden + 1 output conv (+ bns)
+    gin("convs_node_hidden.0", HID, h0)
+    bn("batch_norms_node_hidden.0", h0)
+    gin("convs_node_hidden.1", h0, h1)
+    bn("batch_norms_node_hidden.1", h1)
+    gin("convs_node_output.0", h1, 1)
+    bn("batch_norms_node_output.0", 1)
+    # graph head + shared
+    sd.update({f"graph_shared.1.{k}": v for k, v in _lin(gen, SHARED, HID).items()})
+    for idx, (o, i_) in zip((0, 2, 4), ((HEADH, SHARED), (HEADH, HEADH), (1, HEADH))):
+        sd.update({f"heads_NN.0.{idx}.{k}": v for k, v in _lin(gen, o, i_).items()})
+    # heads_NN.1 = ModuleList aliasing the SAME node-chain modules
+    for j, src in enumerate(
+        (
+            "convs_node_hidden.0",
+            "batch_norms_node_hidden.0",
+            "convs_node_hidden.1",
+            "batch_norms_node_hidden.1",
+            "convs_node_output.0",
+            "batch_norms_node_output.0",
+        )
+    ):
+        for k in list(sd):
+            if k.startswith(src + "."):
+                sd[f"heads_NN.1.{j}" + k[len(src):]] = sd[k]
+
+    model = create_model(
+        model_type="GIN",
+        input_dim=IN,
+        hidden_dim=HID,
+        output_dim=[1, 1],
+        output_type=["graph", "node"],
+        output_heads={
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": SHARED,
+                "num_headlayers": 2,
+                "dim_headlayers": [HEADH, HEADH],
+            },
+            "node": {
+                "type": "conv",
+                "num_headlayers": 2,
+                "dim_headlayers": [h0, h1],
+            },
+        },
+        task_weights=[1.0, 1.0],
+        num_conv_layers=2,
+    )
+    batch = _example_batch(np.random.default_rng(9), n_heads=2)
+    variables = init_model_variables(model, batch, seed=0)
+    path = tmp_path / "ref.pk"
+    torch.save({"model_state_dict": sd}, str(path))
+    new_vars, report = import_torch_checkpoint(str(path), model, variables)
+    assert report["ignored"] == [], report["ignored"]
+    np.testing.assert_array_equal(
+        new_vars["params"]["node_conv_1"]["mlp_0"]["kernel"],
+        sd["convs_node_hidden.1.nn.0.weight"].numpy().T,
+    )
+    np.testing.assert_array_equal(
+        new_vars["batch_stats"]["node_out_bn_0"]["var"],
+        sd["batch_norms_node_output.0.module.running_var"].numpy(),
+    )
+    out = model.apply(new_vars, batch, train=False)
+    assert np.all(np.isfinite(np.asarray(out[1])))
+
+
+def pytest_torch_import_mlp_per_node_head(tmp_path):
+    """'mlp_per_node': the reference keeps one Sequential PER node slot; they
+    stack into our [num_nodes, in, out] weight arrays."""
+    gen = np.random.default_rng(10)
+    num_nodes = 4
+    sd = _reference_pna_state_dict(gen, num_nodes_mlp=num_nodes)
+
+    output_heads = {
+        "graph": {
+            "num_sharedlayers": 1,
+            "dim_sharedlayers": SHARED,
+            "num_headlayers": 2,
+            "dim_headlayers": [HEADH, HEADH],
+        },
+        "node": {
+            "type": "mlp_per_node",
+            "num_headlayers": 1,
+            "dim_headlayers": [HEADH],
+        },
+    }
+    model = create_model(
+        model_type="PNA",
+        input_dim=IN,
+        hidden_dim=HID,
+        output_dim=[1, 1],
+        output_type=["graph", "node"],
+        output_heads=output_heads,
+        task_weights=[1.0, 1.0],
+        num_conv_layers=2,
+        edge_dim=EDGE,
+        num_nodes=num_nodes,
+        pna_deg=np.array([0.0, 0.0, 1.0], np.float32),
+    )
+    batch = _example_batch(np.random.default_rng(11), n_heads=2)
+    variables = init_model_variables(model, batch, seed=0)
+    path = tmp_path / "ref.pk"
+    torch.save({"model_state_dict": sd}, str(path))
+    new_vars, report = import_torch_checkpoint(str(path), model, variables)
+    assert report["ignored"] == [], report["ignored"]
+    p = new_vars["params"]["head_1"]
+    assert p["w_0"].shape == (num_nodes, HID, HEADH)
+    # node slot 2, layer 1 == heads_NN.1.mlp.2.2 transposed
+    np.testing.assert_array_equal(
+        p["w_1"][2], sd["heads_NN.1.mlp.2.2.weight"].numpy().T
+    )
+    np.testing.assert_array_equal(
+        p["b_0"][3], sd["heads_NN.1.mlp.3.0.bias"].numpy()
+    )
+    out = model.apply(new_vars, batch, train=False)
+    assert np.all(np.isfinite(np.asarray(out[1])))
