@@ -43,6 +43,11 @@ def main():
     ap.add_argument("--n", type=int, default=4096)
     ap.add_argument("--out", default=None)
     ap.add_argument(
+        "--skip", choices=("off", "on", "both"), default="off",
+        help="sweep the block-skip variant (HYDRAGNN_PALLAS_SKIP) per "
+        "candidate: off / on / both arms",
+    )
+    ap.add_argument(
         "--cpu", action="store_true",
         help="force the CPU interpreter in children (plumbing smoke test "
         "only — timings are meaningless off-TPU)",
@@ -56,9 +61,15 @@ def main():
     if not candidates:
         sys.exit("--candidates is empty")
 
+    skip_arms = {"off": ("0",), "on": ("1",), "both": ("0", "1")}[args.skip]
     rows = []
-    for be in candidates:
-        env = dict(os.environ, HYDRAGNN_PALLAS_BE=str(be), HYDRAGNN_PALLAS="1")
+    for be, skip in ((b, s) for b in candidates for s in skip_arms):
+        env = dict(
+            os.environ,
+            HYDRAGNN_PALLAS_BE=str(be),
+            HYDRAGNN_PALLAS="1",
+            HYDRAGNN_PALLAS_SKIP=skip,
+        )
         if args.cpu:
             env["HYDRAGNN_TUNE_CPU"] = "1"
         try:
@@ -73,20 +84,21 @@ def main():
         except subprocess.TimeoutExpired:
             # Dead accelerator tunnel hangs the child (TPU_PROBES.jsonl
             # failure mode): record the row and keep sweeping.
-            rows.append({"be": be, "error": "child timed out after 900s"})
+            rows.append({"be": be, "skip": skip == "1", "error": "child timed out after 900s"})
             print(json.dumps(rows[-1]), flush=True)
             continue
         line = next(
             (l for l in proc.stdout.splitlines() if l.startswith("RESULT ")), None
         )
         if line is None:
-            rows.append({"be": be, "error": (proc.stderr or proc.stdout)[-300:]})
+            rows.append({"be": be, "skip": skip == "1", "error": (proc.stderr or proc.stdout)[-300:]})
             print(json.dumps(rows[-1]), flush=True)
             continue
         r = json.loads(line[len("RESULT ") :])
         rows.append(
             {
                 "be": be,
+                "skip": r.get("pallas_skip", skip == "1"),
                 "ok": r["ok"],
                 "pallas_ms": r["pallas_ms"],
                 "xla_ms": r["xla_ms"],
@@ -102,9 +114,9 @@ def main():
         "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "workload": {"e": args.e, "f": args.f, "n": args.n},
         "rows": rows,
-        "best_be": best and best["be"],
+        "best": best and {"be": best["be"], "skip": best["skip"]},
     }
-    print(json.dumps({"best_be": summary["best_be"]}))
+    print(json.dumps({"best": summary["best"]}))
     if args.out:
         with open(args.out, "a") as f:
             f.write(json.dumps(summary) + "\n")

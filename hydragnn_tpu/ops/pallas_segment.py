@@ -158,6 +158,62 @@ def _sum_count_split_kernel(ids_ref, hi_ref, lo_ref, sum_ref, cnt_ref):
     cnt_ref[:] += jnp.sum(onehot, axis=1, keepdims=True)
 
 
+def pallas_skip_enabled() -> bool:
+    """Block-skip variant (HYDRAGNN_PALLAS_SKIP=1): collation packs graphs
+    contiguously, so each edge block's receivers span a narrow node window and
+    most (node-block, edge-block) grid pairs provably cannot interact. The
+    variant scalar-prefetches per-edge-block receiver ranges, predicates the
+    one-hot matmul away for non-overlapping pairs (pl.when), and clamps the
+    skipped pairs' DMA index to block 0 so revisited blocks do not re-fetch —
+    on a diagonal-ish pattern this cuts both MXU work and HBM traffic by
+    ~E_blocks/overlap. Default OFF until measured on hardware (the accelerator
+    tunnel was down the round this landed); correctness is interpreter-tested
+    either way and benchmarks/tune_kernel.py can sweep it via the env."""
+    return os.environ.get("HYDRAGNN_PALLAS_SKIP", "0") not in ("0", "false", "False")
+
+
+def _block_overlap(i, j, lo_ref, hi_ref):
+    """Can edge block j's receivers touch node block i? ONE definition shared
+    by the skip kernel's compute predicate and the DMA index maps — if these
+    ever diverged, a pair the index map clamps to block 0 could still compute,
+    silently accumulating the wrong edge data."""
+    base = i * _BN
+    return (hi_ref[j] >= base) & (lo_ref[j] < base + _BN)
+
+
+def _skip_kernel():
+    """Block-skip twin of _sum_count_kernel/_sum_count_split_kernel (any
+    operand count): same accumulation math, guarded by the prefetched
+    receiver-range overlap test."""
+    import jax.experimental.pallas as pl
+
+    def kern(lo_ref, hi_ref, ids_ref, *args):
+        ops, sum_ref, cnt_ref = args[:-2], args[-2], args[-1]
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            sum_ref[:] = jnp.zeros_like(sum_ref)
+            cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+        base = i * _BN
+
+        @pl.when(_block_overlap(i, j, lo_ref, hi_ref))
+        def _():
+            rows = jax.lax.broadcasted_iota(jnp.int32, (_BN, _BE), 0) + base
+            onehot = (rows == ids_ref[:]).astype(jnp.float32)
+            acc = jnp.dot(onehot, ops[0][:], preferred_element_type=jnp.float32)
+            for op in ops[1:]:
+                acc = acc + jnp.dot(
+                    onehot, op[:], preferred_element_type=jnp.float32
+                )
+            sum_ref[:] += acc
+            cnt_ref[:] += jnp.sum(onehot, axis=1, keepdims=True)
+
+    return kern
+
+
 def _sum_count_pallas(
     data: jnp.ndarray,
     ids: jnp.ndarray,
@@ -211,14 +267,53 @@ def _sum_count_pallas(
         jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
     ]
     ids_spec = pl.BlockSpec((1, _BE), lambda i, j: (0, j))
-    out_sum, out_cnt = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[ids_spec] + [edge_spec] * len(operands),
-        out_specs=out_specs,
-        out_shape=out_shape,
-        interpret=interpret,
-    )(ids_p, *operands)
+    if pallas_skip_enabled():
+        from jax.experimental.pallas import tpu as pltpu
+
+        nblk_e = e_pad // _BE
+        blk = ids_p[0].reshape(nblk_e, _BE)
+        valid = blk >= 0
+        lo = jnp.where(valid, blk, jnp.int32(2147483647)).min(axis=1)
+        hi = jnp.where(valid, blk, jnp.int32(-1)).max(axis=1)
+
+        def _edge_idx(i, j, lo_ref, hi_ref):
+            # Skipped pairs re-address block 0: an unchanged block index means
+            # the pipeline skips the DMA, so skipped iterations cost no HBM.
+            return (jnp.where(_block_overlap(i, j, lo_ref, hi_ref), j, 0), 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, _BE),
+                    lambda i, j, lo_ref, hi_ref: (
+                        0,
+                        _edge_idx(i, j, lo_ref, hi_ref)[0],
+                    ),
+                )
+            ]
+            + [pl.BlockSpec((_BE, f_pad), _edge_idx)] * len(operands),
+            out_specs=[
+                pl.BlockSpec((_BN, f_pad), lambda i, j, lo_ref, hi_ref: (i, 0)),
+                pl.BlockSpec((_BN, 1), lambda i, j, lo_ref, hi_ref: (i, 0)),
+            ],
+        )
+        out_sum, out_cnt = pl.pallas_call(
+            _skip_kernel(),
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(lo, hi, ids_p, *operands)
+    else:
+        out_sum, out_cnt = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[ids_spec] + [edge_spec] * len(operands),
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(ids_p, *operands)
     total = out_sum[:num_segments, :f]
     if packed:
         total = total + out_sum[:num_segments, 64 : 64 + f]
@@ -534,6 +629,7 @@ def certify_pallas(
     return {
         "backend": _platform(),
         "pallas_enabled": pallas_enabled(),
+        "pallas_skip": pallas_skip_enabled(),
         "ok": max(max_err_fwd, max_err_grad, wide_err_fwd, wide_err_grad) < tol,
         "tol": tol,
         "max_err_fwd": max_err_fwd,

@@ -266,3 +266,64 @@ def pytest_be_override_parity(monkeypatch):
             monkeypatch.setenv("HYDRAGNN_PALLAS_BE", ambient)
         importlib.reload(ps)
     assert ps._BE == (int(ambient) if ambient else 512)
+
+
+def pytest_block_skip_variant_matches_xla(monkeypatch):
+    """HYDRAGNN_PALLAS_SKIP=1 predicates away non-overlapping (node-block,
+    edge-block) pairs via scalar-prefetched receiver ranges and clamps their
+    DMA index; results must be EXACTLY the regular kernel's on multi-block
+    problems — contiguous (collation-like), scattered, and masked ids."""
+    rng = np.random.default_rng(17)
+    e, n, f = 1400, 300, 10  # >2 edge blocks, >2 node blocks
+
+    # Collation-like contiguous receivers (ascending), plus scattered ids.
+    contiguous = jnp.asarray(np.sort(rng.integers(0, n, size=e)).astype(np.int32))
+    scattered = jnp.asarray(rng.integers(0, n, size=e).astype(np.int32))
+    data = jnp.asarray(rng.normal(size=(e, f)).astype(np.float32) * 2.0)
+    mask = jnp.asarray(rng.random(e) > 0.2)
+
+    for ids in (contiguous, scattered):
+        masked_ids = jnp.where(mask, ids, -1)
+        # The reference arm must run WITHOUT skip even if the ambient env
+        # enables it (e.g. while validating the variant on hardware).
+        monkeypatch.delenv("HYDRAGNN_PALLAS_SKIP", raising=False)
+        want_s, want_c = ps.segment_sum_count(data, masked_ids, n, True)
+        monkeypatch.setenv("HYDRAGNN_PALLAS_SKIP", "1")
+        got_s, got_c = ps.segment_sum_count(data, masked_ids, n, True)
+        monkeypatch.delenv("HYDRAGNN_PALLAS_SKIP")
+        np.testing.assert_allclose(got_s, want_s, rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(got_c, want_c)
+
+    # Gradients ride the same custom VJP (gather backward) either way.
+    monkeypatch.setenv("HYDRAGNN_PALLAS_SKIP", "1")
+    g = jax.grad(
+        lambda d: ps.segment_sum_count(d, contiguous, n, True)[0].sum()
+    )(data)
+    monkeypatch.delenv("HYDRAGNN_PALLAS_SKIP")
+    g_ref = jax.grad(
+        lambda d: ps.segment_sum_count(d, contiguous, n, True)[0].sum()
+    )(data)
+    np.testing.assert_allclose(g, g_ref, rtol=1e-6, atol=1e-6)
+
+
+def pytest_block_skip_full_stats_and_model_path(monkeypatch):
+    """The skip variant must compose through fused_segment_stats (split +
+    centered second pass) and the empty-segment edge case."""
+    monkeypatch.setenv("HYDRAGNN_PALLAS_SKIP", "1")
+    rng = np.random.default_rng(19)
+    data, ids, mask, n = _random_problem(rng, e=900, n=200, f=6)
+    total, mean, std, count = ps.fused_segment_stats(
+        data, ids, n, mask=mask, interpret=True
+    )
+    np.testing.assert_allclose(
+        total, seg.segment_sum(data, ids, n, mask=mask), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        std, seg.segment_std(data, ids, n, mask=mask), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(count, seg.segment_count(ids, n, mask=mask), rtol=1e-6)
+
+    # All-masked input: every block is skipped; outputs must be exact zeros.
+    s, c = ps.segment_sum_count(data, jnp.full((900,), -1, jnp.int32), n, True)
+    np.testing.assert_array_equal(c, np.zeros(n))
+    np.testing.assert_array_equal(s, np.zeros((n, 6)))
