@@ -343,12 +343,45 @@ def main():
                 cert = _with_retries(certify_pallas)
                 result["pallas_ok"] = cert["ok"]
                 result["pallas_speedup"] = cert["speedup"]
+                result["pallas_ms"] = cert["pallas_ms"]
                 # Whether the benchmarked workload itself used the kernel
                 # (HYDRAGNN_PALLAS=0 would certify a kernel production skips).
                 result["pallas_enabled"] = cert["pallas_enabled"]
                 result["pallas_max_err"] = max(
                     cert["max_err_fwd"], cert["max_err_grad"]
                 )
+                # Also measure the staged block-skip variant (default-off in
+                # production — ops/pallas_segment.py:pallas_skip_enabled):
+                # this is the hardware measurement the flag is waiting on,
+                # recorded automatically the first round a live chip is
+                # present. Apples-to-apples on CONTIGUOUS (sorted) ids — the
+                # production collation pattern and the only shape on which
+                # skipping is possible (uniformly random ids make every edge
+                # block span all nodes).
+                if not cert["pallas_skip"]:
+                    saved = os.environ.get("HYDRAGNN_PALLAS_SKIP")
+                    try:
+                        base_c = _with_retries(
+                            lambda: certify_pallas(contiguous=True)
+                        )
+                        os.environ["HYDRAGNN_PALLAS_SKIP"] = "1"
+                        skip_c = _with_retries(
+                            lambda: certify_pallas(contiguous=True)
+                        )
+                        result["pallas_skip_ok"] = skip_c["ok"]
+                        result["pallas_ms_contiguous"] = base_c["pallas_ms"]
+                        result["pallas_skip_ms_contiguous"] = skip_c["pallas_ms"]
+                        result["pallas_skip_speedup"] = round(
+                            base_c["pallas_ms"] / skip_c["pallas_ms"], 3
+                        )
+                    except Exception as e:
+                        result["pallas_skip_ok"] = False
+                        result["pallas_skip_error"] = f"{type(e).__name__}: {e}"
+                    finally:
+                        if saved is None:
+                            os.environ.pop("HYDRAGNN_PALLAS_SKIP", None)
+                        else:
+                            os.environ["HYDRAGNN_PALLAS_SKIP"] = saved
             except Exception as e:
                 result["pallas_ok"] = False
                 result["pallas_error"] = f"{type(e).__name__}: {e}"

@@ -29,7 +29,11 @@ if os.environ.get("HYDRAGNN_TUNE_CPU"):
     import jax
     jax.config.update("jax_platforms", "cpu")
 from hydragnn_tpu.ops.pallas_segment import certify_pallas, _BE
-r = certify_pallas(e=int(sys.argv[1]), f=int(sys.argv[2]), n=int(sys.argv[3]))
+# contiguous (sorted) ids = the production collation pattern; also the only
+# shape where the HYDRAGNN_PALLAS_SKIP arm can actually skip blocks.
+r = certify_pallas(
+    e=int(sys.argv[1]), f=int(sys.argv[2]), n=int(sys.argv[3]), contiguous=True
+)
 r["be"] = _BE
 print("RESULT " + json.dumps(r))
 """

@@ -501,6 +501,7 @@ def certify_pallas(
     n: int = 4096,
     reps: int = 20,
     seed: int = 0,
+    contiguous: bool = False,
 ) -> dict:
     """On-device certification of the fused kernel against the XLA segment
     ops: forward + gradient parity on the PNA aggregation workload (reference
@@ -514,6 +515,13 @@ def certify_pallas(
     max_err_grad, xla_err_fwd, xla_err_grad, speedup, pallas_ms, xla_ms}.
     Uses whatever platform pallas gating currently resolves to (pin with
     ``pallas_platform`` / HYDRAGNN_PALLAS as needed).
+
+    ``contiguous=True`` SORTS the segment ids — the production pattern
+    (collation packs graphs contiguously, so receivers ascend across the edge
+    array). This is the shape on which the block-skip variant
+    (HYDRAGNN_PALLAS_SKIP) can skip work; with uniformly random ids every
+    edge block spans all nodes and nothing is skippable, so a skip-vs-base
+    comparison on random ids is structurally meaningless.
     """
     import time
 
@@ -524,6 +532,8 @@ def certify_pallas(
         k1, k2, k3 = jax.random.split(key, 3)
         data = jax.random.normal(k1, (e_, f_), jnp.float32) * 2.0 + 1.0
         ids = jax.random.randint(k2, (e_,), 0, n_)
+        if contiguous:
+            ids = jnp.sort(ids)
         mask = jax.random.uniform(k3, (e_,)) > 0.1
         return data, ids, mask
 
@@ -630,6 +640,7 @@ def certify_pallas(
         "backend": _platform(),
         "pallas_enabled": pallas_enabled(),
         "pallas_skip": pallas_skip_enabled(),
+        "contiguous_ids": contiguous,
         "ok": max(max_err_fwd, max_err_grad, wide_err_fwd, wide_err_grad) < tol,
         "tol": tol,
         "max_err_fwd": max_err_fwd,
