@@ -54,7 +54,11 @@ def pytest_collection_modifyitems(config, items):
     # the global mesh, rank-0 file writes behind barriers): the convergence
     # matrix AND checkpoint-reload/predict (train → save → fresh model →
     # load_existing_model → evaluate under 2 ranks).
-    world_safe = {"test_graphs.py", "test_model_loadpred.py"}
+    world_safe = {
+        "test_graphs.py",
+        "test_model_loadpred.py",
+        "test_resume_2proc.py",
+    }
     skip_local = pytest.mark.skip(
         reason="single-process test (local virtual mesh) under multi-process run"
     )

@@ -59,10 +59,53 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
     if use_lengths:
         config["NeuralNetwork"]["Architecture"]["edge_features"] = ["lengths"]
 
-    # Generate raw text datasets if needed. Rank 0 generates; other ranks of a
-    # multi-process run (the mpirun -n 2 CI analog) wait on a sibling sentinel
-    # so shared fixture files are never written concurrently.
-    num_samples_tot = 500
+    ensure_raw_datasets(config)
+
+    # PNA without lengths exercises the config-file overload of run_training
+    # (reference test_graphs.py:109-114).
+    if model_type == "PNA" and not use_lengths:
+        hydragnn_tpu.run_training(config_file)
+    else:
+        hydragnn_tpu.run_training(config)
+
+    error, error_rmse_task, true_values, predicted_values = (
+        hydragnn_tpu.run_prediction(config)
+    )
+
+    thresholds = dict(THRESHOLDS)
+    if use_lengths and "vector" not in ci_input:
+        thresholds.update(THRESHOLDS_LENGTHS)
+    if use_lengths and "vector" in ci_input:
+        thresholds.update(THRESHOLDS_VECTOR)
+
+    for ihead in range(len(true_values)):
+        error_head_rmse = error_rmse_task[ihead]
+        assert (
+            error_head_rmse < thresholds[model_type][0]
+        ), f"Head RMSE checking failed for {ihead}: {error_head_rmse}"
+
+        head_true = np.asarray(true_values[ihead])
+        head_pred = np.asarray(predicted_values[ihead])
+        sample_mean_abs_error = np.abs(head_true - head_pred).mean()
+        sample_max_abs_error = np.abs(head_true - head_pred).max()
+        assert (
+            sample_mean_abs_error < thresholds[model_type][1]
+        ), f"MAE sample checking failed: {sample_mean_abs_error}"
+        assert (
+            sample_max_abs_error < thresholds[model_type][2]
+        ), f"Max. sample checking failed: {sample_max_abs_error}"
+
+    assert error < thresholds[model_type][0], (
+        "Total RMSE checking failed!" + str(error)
+    )
+
+
+def ensure_raw_datasets(config, num_samples_tot=500):
+    """Generate the deterministic raw text datasets a config points at, if
+    missing. Rank 0 generates; other ranks of a multi-process run (the
+    mpirun -n 2 CI analog) wait on a sibling sentinel so shared fixture files
+    are never written concurrently. World-safe tests outside this file
+    (e.g. test_resume_2proc.py) share this helper."""
     pkl_input = list(config["Dataset"]["path"].values())[0].endswith(".pkl")
     if not pkl_input:
         import time as _time
@@ -105,7 +148,13 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
                     "validate": int(num_samples_tot * (1 - perc_train) * 0.5),
                 }[dataset_name]
                 os.makedirs(data_path, exist_ok=True)
-                if not os.listdir(data_path):
+                # One file per configuration: any other count means a crashed
+                # earlier generation left a partial directory — regenerate
+                # rather than fingerprinting incomplete data as "done".
+                existing = os.listdir(data_path)
+                if len(existing) != num_samples:
+                    for name in existing:
+                        os.remove(os.path.join(data_path, name))
                     deterministic_graph_data(
                         data_path, number_configurations=num_samples
                     )
@@ -127,44 +176,6 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
                     if _time.time() > deadline:
                         raise TimeoutError(f"rank 0 never finished {data_path}")
                     _time.sleep(0.1)
-
-    # PNA without lengths exercises the config-file overload of run_training
-    # (reference test_graphs.py:109-114).
-    if model_type == "PNA" and not use_lengths:
-        hydragnn_tpu.run_training(config_file)
-    else:
-        hydragnn_tpu.run_training(config)
-
-    error, error_rmse_task, true_values, predicted_values = (
-        hydragnn_tpu.run_prediction(config)
-    )
-
-    thresholds = dict(THRESHOLDS)
-    if use_lengths and "vector" not in ci_input:
-        thresholds.update(THRESHOLDS_LENGTHS)
-    if use_lengths and "vector" in ci_input:
-        thresholds.update(THRESHOLDS_VECTOR)
-
-    for ihead in range(len(true_values)):
-        error_head_rmse = error_rmse_task[ihead]
-        assert (
-            error_head_rmse < thresholds[model_type][0]
-        ), f"Head RMSE checking failed for {ihead}: {error_head_rmse}"
-
-        head_true = np.asarray(true_values[ihead])
-        head_pred = np.asarray(predicted_values[ihead])
-        sample_mean_abs_error = np.abs(head_true - head_pred).mean()
-        sample_max_abs_error = np.abs(head_true - head_pred).max()
-        assert (
-            sample_mean_abs_error < thresholds[model_type][1]
-        ), f"MAE sample checking failed: {sample_mean_abs_error}"
-        assert (
-            sample_max_abs_error < thresholds[model_type][2]
-        ), f"Max. sample checking failed: {sample_max_abs_error}"
-
-    assert error < thresholds[model_type][0], (
-        "Total RMSE checking failed!" + str(error)
-    )
 
 
 @pytest.mark.parametrize("model_type", ["SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN"])
