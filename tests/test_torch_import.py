@@ -471,3 +471,24 @@ def pytest_torch_import_mlp_per_node_head(tmp_path):
     )
     out = model.apply(new_vars, batch, train=False)
     assert np.all(np.isfinite(np.asarray(out[1])))
+
+
+def pytest_torch_import_ddp_prefixed_checkpoint(tmp_path):
+    """Reference checkpoints saved from a DDP-wrapped model carry 'module.'
+    on every key (utils/model.py:70-76 strips them on load; our importer must
+    too)."""
+    gen = np.random.default_rng(12)
+    sd = _reference_pna_state_dict(gen)
+    ddp_sd = collections.OrderedDict(("module." + k, v) for k, v in sd.items())
+    path = tmp_path / "ddp.pk"
+    torch.save({"model_state_dict": ddp_sd}, str(path))
+
+    model = _make_model()
+    batch = _example_batch(np.random.default_rng(13))
+    variables = init_model_variables(model, batch, seed=0)
+    new_vars, report = import_torch_checkpoint(str(path), model, variables)
+    assert report["ignored"] == [], report["ignored"]
+    np.testing.assert_array_equal(
+        new_vars["params"]["graph_shared"]["dense_0"]["kernel"],
+        sd["graph_shared.1.weight"].numpy().T,
+    )
