@@ -173,6 +173,27 @@ def build_production_pipeline(batch_size: "int | None" = None) -> dict:
         )
         if os.path.exists(pkl):
             config["Dataset"]["path"][split] = pkl
+    # Self-contained: generate the deterministic raw dataset if the serialized
+    # pkl is absent and the raw text folder is missing OR partial (a crashed
+    # earlier generation must not be silently benchmarked — same count guard
+    # as tests/test_graphs.py ensure_raw_datasets). Paths are anchored at the
+    # repo dir and written back ABSOLUTE so RawDataLoader (which resolves
+    # relative paths against os.getcwd()) agrees regardless of invocation cwd.
+    N_RAW = 500
+    for split, p in config["Dataset"]["path"].items():
+        if p.endswith(".pkl"):
+            continue
+        raw = p if os.path.isabs(p) else os.path.join(repo, p)
+        config["Dataset"]["path"][split] = raw
+        existing = os.listdir(raw) if os.path.isdir(raw) else None
+        if existing is None or len(existing) != N_RAW:
+            sys.path.insert(0, os.path.join(repo, "tests"))
+            from deterministic_graph_data import deterministic_graph_data
+
+            os.makedirs(raw, exist_ok=True)
+            for name in existing or ():
+                os.remove(os.path.join(raw, name))
+            deterministic_graph_data(raw, number_configurations=N_RAW)
     # Production bucketing plumbing: two shape buckets over the train split.
     config["Dataset"]["num_buckets"] = 2
     if batch_size is not None:

@@ -8,14 +8,19 @@ proven offline is recorded in two runs through the real production pipeline
 (load → pre_transform → radius graph → split → loaders → config completion →
 PNA → train → evaluate):
 
-- ``real_gdb9``: the genuine dsgdb9nsd_00000{1..5}.xyz records committed under
-  tests/fixtures/qm9_raw (published bytes, incl. ``*^`` exponents) — proves
-  the real-format path end-to-end: parse, graph-build, train to near-zero
-  fit error on real molecules.
+- ``real_gdb9_fit``: the genuine dsgdb9nsd_00000{1..5}.xyz records committed
+  under tests/fixtures/qm9_raw (published bytes, incl. ``*^`` exponents) —
+  proves the real-format path end-to-end: parse, graph-build, train to
+  near-zero fit error on real molecules. protocol=fit_only (train==test).
+- ``real_gdb9_loo``: leave-one-out over those 5 records — the only honest
+  held-out protocol a 5-record corpus admits. protocol=held_out.
 - ``synthetic_1000``: the deterministic offline stand-in at example scale —
-  proves convergence + measures graphs/sec on a 1000-molecule corpus.
+  held-out example split; the HEADLINE number until egress exists.
 
-Usage: python benchmarks/qm9_northstar.py [--out QM9_r04.json] [--epochs N]
+Every block carries a ``protocol`` field ("held_out" | "fit_only"); fit-only
+blocks emit ``fit_*`` keys, never ``test_*`` (VERDICT r04 item 2).
+
+Usage: python benchmarks/qm9_northstar.py [--out QM9_r05.json] [--epochs N]
 Runs on whatever platform JAX resolves (CPU when the TPU tunnel is down —
 recorded in the artifact).
 """
@@ -69,6 +74,7 @@ def _run_pipeline(
     epochs: int,
     lr: float = None,
     full_batch: bool = False,
+    loo_index: int = None,
 ) -> dict:
     import numpy as np
 
@@ -98,14 +104,30 @@ def _run_pipeline(
         if os.path.isdir(os.path.join(dataset_root, "raw"))
         else 0
     )
-    # Tiny corpora can't be stratified-split three ways; train==val==test==all
-    # (fit demonstration), else the example's split.
-    if len(dataset) >= 30:
+    # Split protocol — every result block is labeled with it so a fit-only
+    # number can never be mistaken for generalization (VERDICT r04 item 2):
+    #   held_out  — test graphs disjoint from train (the example's split, or
+    #               leave-one-out via ``loo_index``)
+    #   fit_only  — train==test (tiny-corpus fit demonstration); MAE keys are
+    #               renamed ``fit_*`` and no ``test_*`` key is emitted.
+    if loo_index is not None:
+        all_graphs = list(dataset)
+        test = [all_graphs[loo_index]]
+        train = val = [g for i, g in enumerate(all_graphs) if i != loo_index]
+        protocol = "held_out"
+    elif len(dataset) >= 30:
         train, val, test = hydragnn.preprocess.split_dataset(
             dataset, config["NeuralNetwork"]["Training"]["perc_train"], False
         )
+        protocol = "held_out"
     else:
         train = val = test = list(dataset)
+        protocol = "fit_only"
+    # Enforce the label: a held_out block must have zero train/test overlap.
+    if protocol == "held_out":
+        assert not (set(map(id, train)) & set(map(id, test))), (
+            "held_out protocol violated: test graphs appear in train"
+        )
     # A corpus smaller than the batch trains as ONE full batch: with tiny
     # ragged batches the BatchNorm running statistics never match any batch's
     # own statistics and eval error decouples from train error.
@@ -134,20 +156,32 @@ def _run_pipeline(
         t0 = time.time()
         driver.train_epoch(train_loader)
         t_epochs.append(time.time() - t0)
-    t_epochs = t_epochs[:1] + [round(sum(t_epochs[1:]) / max(len(t_epochs) - 1, 1), 4)]
+    # Steady state excludes the compile epoch; a 1-epoch run has no steady
+    # sample, so fall back to the compile epoch rather than reporting 0.
+    steady_avg = (
+        round(sum(t_epochs[1:]) / (len(t_epochs) - 1), 4)
+        if len(t_epochs) > 1
+        else round(t_epochs[0], 4) if t_epochs else 0.0
+    )
+    t_epochs = t_epochs[:1] + [steady_avg]
     loss, rmses, tv, pv = driver.evaluate(test_loader, return_values=True)
     mae = float(np.mean(np.abs(np.asarray(tv[0]) - np.asarray(pv[0]))))
     # Steady-state throughput: exclude the first (compile) epoch when possible.
     steady = t_epochs[-1]
+    # ``test_*`` keys exist ONLY under the held_out protocol; a fit-only run
+    # reports ``fit_*`` so the number cannot be read as generalization.
+    tag = "test" if protocol == "held_out" else "fit"
     return {
+        "protocol": protocol,
         "num_samples": len(dataset),
         "real_gdb9_files": n_real_files,
         "num_train_graphs": len(train),
+        "num_test_graphs": len(test),
         "epochs": epochs,
-        "test_loss": round(float(loss), 6),
-        "test_rmse": [round(float(r), 6) for r in np.atleast_1d(rmses)],
-        "test_mae_eV_per_atom": round(mae * 27.2114, 6),  # target is Ha/atom
-        "test_mae_Ha_per_atom": round(mae, 6),
+        f"{tag}_loss": round(float(loss), 6),
+        f"{tag}_rmse": [round(float(r), 6) for r in np.atleast_1d(rmses)],
+        f"{tag}_mae_eV_per_atom": round(mae * 27.2114, 6),  # target is Ha/atom
+        f"{tag}_mae_Ha_per_atom": round(mae, 6),
         "graphs_per_sec": round(len(train) / max(steady, 1e-9), 2),
         "compile_epoch_s": round(t_epochs[0], 2),
         "steady_epoch_s": steady,
@@ -156,7 +190,7 @@ def _run_pipeline(
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default=os.path.join(REPO, "QM9_r04.json"))
+    ap.add_argument("--out", default=os.path.join(REPO, "QM9_r05.json"))
     ap.add_argument("--epochs", type=int, default=600)
     ap.add_argument("--synthetic-epochs", type=int, default=40)
     ap.add_argument("--workdir", default=None)
@@ -197,15 +231,46 @@ def main():
         )
         # 5 molecules fit with a hot LR in one full batch (Adam's per-step
         # travel at lr=1e-3 cannot cross the ~-9 Ha/atom offset in any
-        # reasonable epoch count).
-        result["real_gdb9"] = _run_pipeline(
+        # reasonable epoch count). protocol=fit_only: train==test.
+        result["real_gdb9_fit"] = _run_pipeline(
             _pna_config(), real_root, None, args.epochs, lr=0.02, full_batch=True
         )
-        # Synthetic stand-in at example scale.
+        # Honest held-out on the real bytes: leave-one-out over the 5
+        # committed molecules (train 4 / test 1 per fold). Tiny, but every
+        # tested molecule is unseen — the only held-out protocol a 5-record
+        # corpus admits. Corpus growth is egress-blocked (download_probe).
+        folds = []
+        for i in range(5):
+            folds.append(
+                _run_pipeline(
+                    _pna_config(), real_root, None, args.epochs,
+                    lr=0.02, full_batch=True, loo_index=i,
+                )
+            )
+        result["real_gdb9_loo"] = {
+            "protocol": "held_out",
+            "method": "leave-one-out over 5 committed GDB-9 records",
+            "test_mae_Ha_per_atom_per_fold": [
+                f["test_mae_Ha_per_atom"] for f in folds
+            ],
+            "test_mae_Ha_per_atom_mean": round(
+                sum(f["test_mae_Ha_per_atom"] for f in folds) / len(folds), 6
+            ),
+            "epochs_per_fold": args.epochs,
+        }
+        # Synthetic stand-in at example scale — held-out example split; the
+        # HEADLINE number until egress exists.
         result["synthetic_1000"] = _run_pipeline(
             _pna_config(), os.path.join(work, "qm9_synth"), 1000,
             args.synthetic_epochs,
         )
+        result["headline"] = {
+            "metric": "synthetic_1000 held-out test MAE (Ha/atom)",
+            "value": result["synthetic_1000"]["test_mae_Ha_per_atom"],
+            "protocol": result["synthetic_1000"]["protocol"],
+            "note": "real-QM9 generalization unmeasurable offline; "
+            "real_gdb9_loo is the held-out protocol on real bytes",
+        }
     finally:
         os.chdir(cwd)
 

@@ -1,0 +1,24 @@
+#!/bin/bash
+# One-shot hardware batch (VERDICT r04 item 1). Each step writes its artifact
+# immediately so partial progress survives a tunnel death mid-batch.
+cd /root/repo
+LOG=/root/repo/hw_batch.log
+echo "=== hardware batch start $(date -u +%FT%TZ) ===" >> "$LOG"
+
+echo "--- [1/3] bench.py ---" >> "$LOG"
+timeout 2400 python bench.py > /tmp/bench_r05.out 2>> "$LOG"
+RC=$?
+echo "bench rc=$RC" >> "$LOG"
+# keep only the final JSON line as the artifact
+tail -1 /tmp/bench_r05.out > BENCH_r05_hw.json
+cat /tmp/bench_r05.out >> "$LOG"
+
+echo "--- [2/3] tune_kernel --skip both ---" >> "$LOG"
+timeout 3600 python benchmarks/tune_kernel.py --skip both --out TUNE_KERNEL_r05.jsonl >> "$LOG" 2>&1
+echo "tune rc=$?" >> "$LOG"
+
+echo "--- [3/3] profile_epoch axon ---" >> "$LOG"
+timeout 2400 python benchmarks/profile_epoch.py --platform axon --trace --out PROFILE_r05.json >> "$LOG" 2>&1
+echo "profile rc=$?" >> "$LOG"
+
+echo "=== hardware batch end $(date -u +%FT%TZ) ===" >> "$LOG"

@@ -58,15 +58,19 @@ _BN = 128  # node-block rows (one MXU tile edge)
 # Edge-block columns per grid step. Env-overridable (HYDRAGNN_PALLAS_BE) so
 # benchmarks/tune_kernel.py can sweep block sizes on hardware without code
 # edits; must be a multiple of 128 (lane count).
+# A malformed value must not abort unrelated imports (code that never touches
+# Pallas, or runs with HYDRAGNN_PALLAS=0): record the error here and raise it
+# from _sum_count_pallas when the kernel is actually requested.
+_BE_ERROR: Optional[str] = None
 try:
     _BE = int(os.environ.get("HYDRAGNN_PALLAS_BE", "512"))
 except ValueError:
-    raise ValueError(
+    _BE, _BE_ERROR = 512, (
         "HYDRAGNN_PALLAS_BE must be an integer multiple of 128, got "
         f"{os.environ['HYDRAGNN_PALLAS_BE']!r}"
-    ) from None
-if _BE <= 0 or _BE % 128 != 0:
-    raise ValueError(
+    )
+if _BE_ERROR is None and (_BE <= 0 or _BE % 128 != 0):
+    _BE, _BE_ERROR = 512, (
         f"HYDRAGNN_PALLAS_BE={_BE} must be a positive multiple of 128 (lanes)"
     )
 
@@ -168,7 +172,11 @@ def pallas_skip_enabled() -> bool:
     on a diagonal-ish pattern this cuts both MXU work and HBM traffic by
     ~E_blocks/overlap. Default OFF until measured on hardware (the accelerator
     tunnel was down the round this landed); correctness is interpreter-tested
-    either way and benchmarks/tune_kernel.py can sweep it via the env."""
+    either way and benchmarks/tune_kernel.py can sweep it via the env.
+
+    Read at TRACE time: like HYDRAGNN_PALLAS / HYDRAGNN_PALLAS_BE, this flag
+    must be set before the process traces its first step — a later env toggle
+    does not affect already-cached traces under jit."""
     return os.environ.get("HYDRAGNN_PALLAS_SKIP", "0") not in ("0", "false", "False")
 
 
@@ -223,6 +231,8 @@ def _sum_count_pallas(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     import jax.experimental.pallas as pl
 
+    if _BE_ERROR is not None:
+        raise ValueError(_BE_ERROR)
     e, f = data.shape
     e_pad = _round_up(max(e, _BE), _BE)
     n_pad = _round_up(max(num_segments, _BN), _BN)

@@ -124,9 +124,12 @@ def _map_conv(
             # pre([xi, xj, Ee+be]) = W_recv xi + W_send xj + (W3 E) e + (b + W3 be)
             w3 = pre_w[:, 2 * f_in :]
             kernel = np.concatenate([pre_w[:, : 2 * f_in], w3 @ enc_w], axis=1).T
-            bias = (pre_b if pre_b is not None else 0.0) + (
-                w3 @ enc_b if enc_b is not None else 0.0
-            )
+            # Both source Linears may be bias=False; the folded bias must stay
+            # a length-f_in vector, not a 0-d scalar, or the template shape
+            # check rejects with a misleading "configs differ" error.
+            bias = (
+                pre_b if pre_b is not None else np.zeros(f_in, np.float32)
+            ) + (w3 @ enc_b if enc_b is not None else 0.0)
             out["pre_nn"] = _dense(kernel, np.asarray(bias, np.float32), template["pre_nn"])
         else:
             out["pre_nn"] = _dense(pre_w.T, pre_b, template["pre_nn"])

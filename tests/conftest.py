@@ -58,6 +58,7 @@ def pytest_collection_modifyitems(config, items):
         "test_graphs.py",
         "test_model_loadpred.py",
         "test_resume_2proc.py",
+        "test_predict_2proc.py",
     }
     skip_local = pytest.mark.skip(
         reason="single-process test (local virtual mesh) under multi-process run"

@@ -92,7 +92,8 @@ def main() -> int:
                 "rc": rcs[rank],
             }
         )
-    sys.stdout.write(open(logs[0][0]).read())
+    with open(logs[0][0]) as f:
+        sys.stdout.write(f.read())
     print(f"rank return codes: {rcs}; tests passed per rank: {ran}")
     if artifact:
         import json

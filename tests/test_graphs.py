@@ -132,15 +132,37 @@ def ensure_raw_datasets(config, num_samples_tot=500):
             return repr(entries) if entries else None
 
         for dataset_name, data_path in config["Dataset"]["path"].items():
-            sentinel = data_path.rstrip("/") + f".done.{run_id}"
+            # Sentinels live in the system temp dir, NOT next to the dataset:
+            # per-port names accumulated in the tree across 2-proc runs
+            # (r03/r04 advisor note). All ranks of one launch share the host,
+            # so tempdir + a digest of the dataset path rendezvous the same.
+            import hashlib
+            import tempfile
+
+            digest = hashlib.md5(
+                os.path.abspath(data_path).encode()
+            ).hexdigest()[:12]
+            sentinel_base = os.path.join(
+                tempfile.gettempdir(), f"hydragnn_dataset_{digest}.done"
+            )
+            sentinel = f"{sentinel_base}.{run_id}"
             if world_rank == 0:
-                # Remove any sentinel left by a previous launch that reused
-                # this port. Waiting ranks additionally validate the sentinel
-                # CONTENT against the live directory state below, so even a
-                # stale sentinel read before this removal cannot release them
+                # Purge this launch's own sentinel plus STALE ones from prior
+                # launches (>1h old — a live concurrent launch's sentinel must
+                # survive, or its waiting ranks would hang to their timeout).
+                # Waiting ranks additionally validate the sentinel CONTENT
+                # against the live directory state below, so even a stale
+                # sentinel read before this removal cannot release them
                 # against an incomplete dataset.
-                if os.path.exists(sentinel):
-                    os.remove(sentinel)
+                import glob as _glob
+
+                now = _time.time()
+                for old in _glob.glob(f"{sentinel_base}.*"):
+                    try:
+                        if old == sentinel or now - os.path.getmtime(old) > 3600:
+                            os.remove(old)
+                    except OSError:
+                        pass
                 num_samples = {
                     "total": num_samples_tot,
                     "train": int(num_samples_tot * perc_train),
