@@ -108,6 +108,18 @@ def main():
                 "xla_ms": r["xla_ms"],
                 "speedup": r["speedup"],
                 "backend": r["backend"],
+                # Full certification error fields: an ok=false row without
+                # magnitudes is undiagnosable after the tunnel dies (r05
+                # lesson — three ok=false rows, no way to tell a tolerance
+                # nit from a broken kernel).
+                "errs": {
+                    k: r.get(k)
+                    for k in (
+                        "max_err_fwd", "max_err_grad", "wide_f",
+                        "wide_err_fwd", "wide_err_grad",
+                        "xla_err_fwd", "xla_err_grad", "tol",
+                    )
+                },
             }
         )
         print(json.dumps(rows[-1]), flush=True)

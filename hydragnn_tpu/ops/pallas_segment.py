@@ -21,10 +21,13 @@ var = mean((x - mean[ids])^2), which has no cancellation and keeps bf16-class
 relative accuracy. Two passes over the edge data instead of five, with the
 scatters on the MXU.
 
-Measured on TPU v5e (E=16k, F=64, N=4k): XLA mean/min/max/std/count bundle
-~88us; this fused path ~50us with min/max still on XLA ``segment_max/min``
-(elementwise extrema cannot ride the MXU and their scatters are not the
-bottleneck).
+Measured on TPU v5e (E=16k, F=64, N=4k) on the ROUND-2 kernel: XLA
+mean/min/max/std/count bundle ~88us; the fused path ~50us with min/max still
+on XLA ``segment_max/min`` (elementwise extrema cannot ride the MXU and their
+scatters are not the bottleneck). The round-4 rework (f-packing + block-skip)
+did NOT hold that win on its first hardware contact (TUNE_KERNEL_r05:
+0.41-0.98x vs XLA, certification failing) — hence the opt-in default; see
+pallas_enabled.
 
 The custom VJP keeps the backward on plain XLA gathers (gathers are fast on
 TPU; only scatter is slow): for (sum, count) the data cotangent is
@@ -101,12 +104,17 @@ def _platform() -> str:
 
 
 def pallas_enabled() -> bool:
-    """True when the fused kernel should run (TPU execution platform, unless
-    overridden by HYDRAGNN_PALLAS=0/1)."""
+    """True when the fused kernel should run. OPT-IN (HYDRAGNN_PALLAS=1)
+    since round 5: the first on-hardware measurements of the reworked kernel
+    (TPU v5e, 2026-07-31, TUNE_KERNEL_r05) showed it both failing its f64
+    certification (ok=false at every swept block size) and slower than the
+    XLA segment bundle (0.41-0.98x). The production default is the certified
+    path; re-enable by default only after certify_pallas passes on hardware
+    with speedup > 1 (tests/test_pallas_tpu.py is the canary)."""
     env = os.environ.get("HYDRAGNN_PALLAS")
     if env is not None:
         return env not in ("0", "false", "False")
-    return _platform() == "tpu"
+    return False
 
 
 def _round_up(x: int, m: int) -> int:
@@ -616,33 +624,44 @@ def certify_pallas(
         )
         return fused_errs, xla_errs
 
-    data, ids, mask = _problem(e, f, n, seed)
-    (max_err_fwd, max_err_grad), (xla_err_fwd, xla_err_grad) = _accuracy(
-        data, ids, mask, n
-    )
-    # The split=True kernel forks on the packing boundary (2f <= 128 packs
-    # hi/lo into one tile; wider shapes run the two-matmul kernel). Certify
-    # BOTH sides: the flagship f (packed when <= 64) above, and a wide shape
-    # exercising _sum_count_split_kernel here — production takes that path
-    # whenever hidden_dim > 64.
-    f_wide = max(2 * f, 96)
-    wide = _problem(e // 4, f_wide, max(n // 4, _BN), seed + 1)
-    (wide_err_fwd, wide_err_grad), _ = _accuracy(*wide, max(n // 4, _BN))
+    # Certification must measure the KERNEL even now that the production
+    # default is the XLA path (fused_* gates on pallas_enabled, which would
+    # otherwise compare XLA to itself). Force-enable for the duration.
+    _saved_env = os.environ.get("HYDRAGNN_PALLAS")
+    os.environ["HYDRAGNN_PALLAS"] = "1"
+    try:
+        data, ids, mask = _problem(e, f, n, seed)
+        (max_err_fwd, max_err_grad), (xla_err_fwd, xla_err_grad) = _accuracy(
+            data, ids, mask, n
+        )
+        # The split=True kernel forks on the packing boundary (2f <= 128 packs
+        # hi/lo into one tile; wider shapes run the two-matmul kernel). Certify
+        # BOTH sides: the flagship f (packed when <= 64) above, and a wide shape
+        # exercising _sum_count_split_kernel here — production takes that path
+        # whenever hidden_dim > 64.
+        f_wide = max(2 * f, 96)
+        wide = _problem(e // 4, f_wide, max(n // 4, _BN), seed + 1)
+        (wide_err_fwd, wide_err_grad), _ = _accuracy(*wide, max(n // 4, _BN))
 
-    fused_bundle, xla_bundle, _ = _bundles(ids, mask, n)
-    f_fused = jax.jit(fused_bundle)
-    f_xla = jax.jit(xla_bundle)
+        fused_bundle, xla_bundle, _ = _bundles(ids, mask, n)
+        f_fused = jax.jit(fused_bundle)
+        f_xla = jax.jit(xla_bundle)
 
-    def best_ms(fn):
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(data))
-            times.append(time.perf_counter() - t0)
-        return 1000.0 * min(times)
+        def best_ms(fn):
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(data))
+                times.append(time.perf_counter() - t0)
+            return 1000.0 * min(times)
 
-    pallas_ms = best_ms(f_fused)
-    xla_ms = best_ms(f_xla)
+        pallas_ms = best_ms(f_fused)
+        xla_ms = best_ms(f_xla)
+    finally:
+        if _saved_env is None:
+            os.environ.pop("HYDRAGNN_PALLAS", None)
+        else:
+            os.environ["HYDRAGNN_PALLAS"] = _saved_env
     # Single source of truth for the certification tolerance (bench.py and
     # tests/test_pallas_tpu.py both consume the verdict, not their own pins).
     tol = 5e-4
@@ -681,10 +700,11 @@ def _flatten_trailing(data):
 def fused_segment_sum(
     data, segment_ids, num_segments: int, mask=None, axis_name=None
 ):
-    """Drop-in masked ``segment_sum`` that rides the one-hot MXU kernel on TPU
-    (XLA's TPU scatter-add serializes updates) — used by every conv family's
-    aggregation, not just PNA. Falls back to the XLA path off-TPU. Accepts any
-    [E, ...] float data (trailing dims flattened for the kernel)."""
+    """Drop-in masked ``segment_sum`` used by every conv family's aggregation:
+    the one-hot MXU kernel when opted in (HYDRAGNN_PALLAS=1 — see
+    pallas_enabled for why the default is the XLA path since r05), the masked
+    XLA segment op otherwise. Accepts any [E, ...] float data (trailing dims
+    flattened for the kernel)."""
     total, _ = fused_segment_sum_count(
         data, segment_ids, num_segments, mask=mask, axis_name=axis_name
     )

@@ -23,7 +23,11 @@ def pytest_fused_kernel_certified_on_tpu():
         pytest.skip("requires a real TPU (set HYDRAGNN_TPU_TESTS=1)")
     report = certify_pallas()
     print(f"pallas certification: {report}")
-    assert report["pallas_enabled"], "Pallas gate off on TPU backend"
+    # The kernel is OPT-IN since round 5 (first on-TPU measurements showed
+    # certification failure + <1x speedup); certify_pallas force-enables it
+    # internally, so this test remains the canary for flipping the default
+    # back on: it must be green on hardware before pallas_enabled() defaults
+    # to True again.
     # f32-class accuracy vs the f64 ground truth (bf16 hi/lo split forward,
     # analytic centered backward) — tolerance owned by certify_pallas — and
     # at least as accurate as XLA's bundle, whose uncentered std gradient
