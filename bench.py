@@ -379,18 +379,30 @@ def main():
                 # production collation pattern and the only shape on which
                 # skipping is possible (uniformly random ids make every edge
                 # block span all nodes).
+                # Contiguous baseline: kernel timing on the production id
+                # pattern PLUS the scatter-free sorted arm
+                # (ops/segment_sorted.py) — recorded immediately so a later
+                # skip-arm failure cannot discard these measurements.
+                base_c = _with_retries(
+                    lambda: certify_pallas(contiguous=True)
+                )
+                result["pallas_ms_contiguous"] = base_c["pallas_ms"]
+                result["sorted_ok"] = base_c.get("sorted_ok")
+                result["sorted_ms"] = base_c.get("sorted_ms")
+                result["sorted_err_grad"] = base_c.get("sorted_err_grad")
+                result["sorted_speedup_vs_xla"] = base_c.get(
+                    "sorted_speedup_vs_xla"
+                )
                 if not cert["pallas_skip"]:
                     saved = os.environ.get("HYDRAGNN_PALLAS_SKIP")
                     try:
-                        base_c = _with_retries(
-                            lambda: certify_pallas(contiguous=True)
-                        )
                         os.environ["HYDRAGNN_PALLAS_SKIP"] = "1"
                         skip_c = _with_retries(
-                            lambda: certify_pallas(contiguous=True)
+                            lambda: certify_pallas(
+                                contiguous=True, sorted_arm=False
+                            )
                         )
                         result["pallas_skip_ok"] = skip_c["ok"]
-                        result["pallas_ms_contiguous"] = base_c["pallas_ms"]
                         result["pallas_skip_ms_contiguous"] = skip_c["pallas_ms"]
                         result["pallas_skip_speedup"] = round(
                             base_c["pallas_ms"] / skip_c["pallas_ms"], 3

@@ -63,6 +63,15 @@ bench_pallas() {
   return $rc
 }
 
+bench_sorted() {
+  # Third arm: the scatter-free sorted-segment path in the REAL train step.
+  HYDRAGNN_SEGMENT_SORTED=1 timeout 2400 python bench.py > /tmp/bench_r05_sorted.out
+  local rc=$?
+  tail -1 /tmp/bench_r05_sorted.out > BENCH_r05_sorted.json
+  grep -q '"error"' BENCH_r05_sorted.json && return 1
+  return $rc
+}
+
 certify_full() {
   timeout 1200 python - <<'EOF'
 import json
@@ -85,9 +94,10 @@ profile_axon() {
 
 while true; do
   if [ -f "$MARK/bench_default" ] && [ -f "$MARK/bench_pallas" ] \
+     && [ -f "$MARK/bench_sorted" ] \
      && [ -f "$MARK/certify" ] && [ -f "$MARK/tune" ] && [ -f "$MARK/profile" ]; then
     echo "=== all hardware steps complete $(date -u +%FT%TZ) ===" >> "$LOG"
-    record_probe "done" "watchdog: all 5 hardware artifacts landed"
+    record_probe "done" "watchdog: all 6 hardware artifacts landed"
     exit 0
   fi
   if probe; then
@@ -99,6 +109,7 @@ while true; do
     step certify certify_full
     probe && step bench_default bench_default
     probe && step bench_pallas bench_pallas
+    probe && step bench_sorted bench_sorted
     probe && step tune tune
     probe && step profile profile_axon
   else

@@ -32,7 +32,10 @@ from hydragnn_tpu.ops.pallas_segment import certify_pallas, _BE
 # contiguous (sorted) ids = the production collation pattern; also the only
 # shape where the HYDRAGNN_PALLAS_SKIP arm can actually skip blocks.
 r = certify_pallas(
-    e=int(sys.argv[1]), f=int(sys.argv[2]), n=int(sys.argv[3]), contiguous=True
+    e=int(sys.argv[1]), f=int(sys.argv[2]), n=int(sys.argv[3]), contiguous=True,
+    # The sorted arm does not read _BE/SKIP, so sweeping re-measures nothing:
+    # only the first arm times it (scarce tunnel minutes).
+    sorted_arm=os.environ.get("HYDRAGNN_TUNE_SORTED") == "1",
 )
 r["be"] = _BE
 print("RESULT " + json.dumps(r))
@@ -67,13 +70,16 @@ def main():
 
     skip_arms = {"off": ("0",), "on": ("1",), "both": ("0", "1")}[args.skip]
     rows = []
+    first = True
     for be, skip in ((b, s) for b in candidates for s in skip_arms):
         env = dict(
             os.environ,
             HYDRAGNN_PALLAS_BE=str(be),
             HYDRAGNN_PALLAS="1",
             HYDRAGNN_PALLAS_SKIP=skip,
+            HYDRAGNN_TUNE_SORTED="1" if first else "0",
         )
+        first = False
         if args.cpu:
             env["HYDRAGNN_TUNE_CPU"] = "1"
         try:
@@ -120,6 +126,11 @@ def main():
                         "xla_err_fwd", "xla_err_grad", "tol",
                     )
                 },
+                # Third arm: the scatter-free sorted path (certify measures
+                # it on contiguous ids alongside kernel + XLA).
+                "sorted_ms": r.get("sorted_ms"),
+                "sorted_ok": r.get("sorted_ok"),
+                "sorted_speedup_vs_xla": r.get("sorted_speedup_vs_xla"),
             }
         )
         print(json.dumps(rows[-1]), flush=True)

@@ -132,6 +132,21 @@ class GraphArena:
             self.ei_all = np.zeros((2, 0), np.int32)
             self.ea_all = None
 
+        # Sort each graph's edges by receiver (stable, one-time): message
+        # passing is permutation-invariant over edges, and per-graph sorted
+        # runs + ascending batch node offsets + top-index padding edges make
+        # every collated batch's receivers globally non-decreasing — the
+        # contract the scatter-free sorted segment path requires
+        # (ops/segment_sorted.py). edge_attr rows ride the same permutation.
+        if self.ei_all.shape[1]:
+            graph_of_edge = np.repeat(
+                np.arange(g, dtype=np.int64), self.es
+            )
+            order = np.lexsort((self.ei_all[1], graph_of_edge))
+            self.ei_all = self.ei_all[:, order]
+            if self.ea_all is not None:
+                self.ea_all = self.ea_all[order]
+
         # Unlabeled datasets (inference-only: y/y_loc absent) simply carry no
         # target arenas; requesting head_types at collate then raises.
         if any(s.y is None or s.y_loc is None for s in graphs):

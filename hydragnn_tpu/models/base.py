@@ -293,7 +293,8 @@ class HydraGNN(nn.Module):
 
         # Masked global mean pool (Base.py:247-250).
         x_graph = pallas_segment.fused_segment_mean(
-            x, batch.node_graph, batch.num_graphs_pad, mask=batch.node_mask
+            x, batch.node_graph, batch.num_graphs_pad, mask=batch.node_mask,
+            sorted_ids=True
         )
 
         outputs = []

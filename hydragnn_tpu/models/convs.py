@@ -33,7 +33,7 @@ class SAGEConv(nn.Module):
     @nn.compact
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
         n = x.shape[0]
-        nbr = pallas_segment.fused_segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
+        nbr = pallas_segment.fused_segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name, sorted_ids=True)
         return nn.Dense(self.out_dim, name="lin_nbr")(nbr) + nn.Dense(
             self.out_dim, name="lin_self"
         )(x)
@@ -51,7 +51,7 @@ class GINConv(nn.Module):
     def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
         n = x.shape[0]
         eps = self.param("eps", nn.initializers.constant(self.eps_init), ())
-        agg = pallas_segment.fused_segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name)
+        agg = pallas_segment.fused_segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name, sorted_ids=True)
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)
@@ -78,7 +78,8 @@ class MFCConv(nn.Module):
         w_nbr = self.param("w_nbr", nn.initializers.lecun_normal(), (d, f, self.out_dim))
         b = self.param("bias", nn.initializers.zeros, (d, self.out_dim))
         agg, deg_f = pallas_segment.fused_segment_sum_count(
-            x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name
+            x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name,
+            sorted_ids=True,
         )
         deg = jnp.clip(deg_f.astype(jnp.int32), 0, self.max_degree)
         out = jnp.einsum("nf,nfo->no", x, w_self[deg]) + jnp.einsum(
@@ -159,7 +160,7 @@ class CGConv(nn.Module):
         msgs = gate * core
         # Padding edges carry nonzero softplus output — mask before aggregation.
         msgs = jnp.where(edge_mask[:, None], msgs, 0.0)
-        return x + pallas_segment.fused_segment_sum(msgs, receivers, n, axis_name=self.axis_name)
+        return x + pallas_segment.fused_segment_sum(msgs, receivers, n, axis_name=self.axis_name, sorted_ids=True)
 
 
 class PNAConv(nn.Module):
@@ -193,7 +194,7 @@ class PNAConv(nn.Module):
         # masked XLA segment ops elsewhere — see ops/pallas_segment.py.
         agg, deg = pallas_segment.pna_aggregate(
             msg, receivers, n, self.aggregators,
-            mask=edge_mask, axis_name=self.axis_name,
+            mask=edge_mask, axis_name=self.axis_name, sorted_ids=True,
         )  # agg: [N, A, f]
 
         deg = jnp.maximum(deg, 1.0)

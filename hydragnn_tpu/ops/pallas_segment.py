@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from . import segment as seg
+from . import segment_sorted as srt
 
 _BN = 128  # node-block rows (one MXU tile edge)
 # Edge-block columns per grid step. Env-overridable (HYDRAGNN_PALLAS_BE) so
@@ -382,7 +383,52 @@ def segment_sum_count(
     )
 
 
-def _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std):
+def _stats_forward(
+    data, ids, num_segments, eps, axis_name, interpret, want_std,
+    sorted_route=False,
+):
+    if sorted_route:
+        # Scatter-free path: data arrives pre-zeroed at masked rows and ids
+        # RAW (sorted; masked rows target padding segments). The centered
+        # second pass needs no mask handling — masked rows have data 0 and
+        # a ~0 padding-segment mean, and padding outputs are never consumed.
+        total, count = srt.segment_sum_count_sorted(data, ids, num_segments)
+        if axis_name is not None:
+            total = jax.lax.psum(total, axis_name)
+            count = jax.lax.psum(count, axis_name)
+        safe = jnp.maximum(count, 1.0)[:, None]
+        mean = total / safe
+        if not want_std:
+            return total, mean, jnp.zeros_like(mean), count
+        idx = jnp.clip(ids, 0, num_segments - 1)
+        # sumsq via a CENTERED XLA scatter, not the prefix path: squares are
+        # tiny exactly where 1/std^2 amplifies error (near-degenerate
+        # segments), and prefix-difference noise (~1e-5 abs) there costs
+        # ~5e-3 in the std GRADIENT — 8x worse than even XLA's uncentered
+        # formula at some shapes. The centered scatter has no cancellation
+        # (~1e-6 fwd, ~1e-5 grad, same as the Pallas arm). Masked rows are
+        # exactly zero here (data pre-zeroed, padding-segment mean is 0), so
+        # no mask argument is needed. Net: 4 of 5 scatters still eliminated;
+        # only PNA's std pass keeps one.
+        sumsq = jax.ops.segment_sum(
+            jnp.square(data - mean[idx]), ids, num_segments=num_segments
+        )
+        if axis_name is not None:
+            sumsq = jax.lax.psum(sumsq, axis_name)
+        # Single-element segments have sumsq == 0 identically; pin them to
+        # sqrt(eps) (the bwd already treats their dstd as 0).
+        std = jnp.where(
+            count[:, None] > 1.0,
+            jnp.sqrt(sumsq / safe + eps),
+            jnp.full_like(mean, jnp.sqrt(eps)),
+        )
+        return total, mean, std, count
+    return _stats_forward_pallas(
+        data, ids, num_segments, eps, axis_name, interpret, want_std
+    )
+
+
+def _stats_forward_pallas(data, ids, num_segments, eps, axis_name, interpret, want_std):
     total, count = segment_sum_count(
         data, ids, num_segments, interpret, _wants_split(data.dtype)
     )
@@ -406,18 +452,27 @@ def _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std)
     return total, mean, std, count
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
-def _stats(data, ids, num_segments, eps, axis_name, interpret, want_std):
-    return _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _stats(data, ids, num_segments, eps, axis_name, interpret, want_std,
+           sorted_route=False):
+    return _stats_forward(
+        data, ids, num_segments, eps, axis_name, interpret, want_std,
+        sorted_route,
+    )
 
 
-def _stats_fwd(data, ids, num_segments, eps, axis_name, interpret, want_std):
-    out = _stats_forward(data, ids, num_segments, eps, axis_name, interpret, want_std)
+def _stats_fwd(data, ids, num_segments, eps, axis_name, interpret, want_std,
+               sorted_route=False):
+    out = _stats_forward(
+        data, ids, num_segments, eps, axis_name, interpret, want_std,
+        sorted_route,
+    )
     total, mean, std, count = out
     return out, (data, ids, mean, std, count)
 
 
-def _stats_bwd(num_segments, eps, axis_name, interpret, want_std, res, cots):
+def _stats_bwd(num_segments, eps, axis_name, interpret, want_std, sorted_route,
+               res, cots):
     """Analytic scatter-free backward. With s=Σx, μ=s/n, σ=sqrt(Σ(x-μ)²/n+eps):
     since Σ_e (x_e - μ) = 0 exactly, the μ-coupling inside σ vanishes and
 
@@ -459,6 +514,7 @@ def fused_segment_stats(
     axis_name: Optional[str] = None,
     interpret: Optional[bool] = None,
     want_std: bool = True,
+    sorted_ids: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(sum, mean, std, count) per segment from two fused passes — the PNA
     sum/mean/std aggregator family (drop-in for segment_sum + segment_mean +
@@ -471,10 +527,19 @@ def fused_segment_stats(
     cross-device composition as the scatter path, but two collectives total.
     """
     ids = segment_ids.astype(jnp.int32)
-    if mask is not None:
-        ids = jnp.where(mask, ids, -1)
     if interpret is None:
         interpret = _platform() != "tpu"
+    if sorted_ids and srt.sorted_enabled():
+        # Sorted contract: zero masked rows, keep RAW (sorted) ids — a -1
+        # marker would break the non-decreasing order the path requires.
+        if mask is not None:
+            data = jnp.where(mask[:, None], data, 0)
+        return _stats(
+            data.astype(jnp.float32), ids, num_segments, eps, axis_name,
+            interpret, want_std, True,
+        )
+    if mask is not None:
+        ids = jnp.where(mask, ids, -1)
     return _stats(data, ids, num_segments, eps, axis_name, interpret, want_std)
 
 
@@ -520,6 +585,7 @@ def certify_pallas(
     reps: int = 20,
     seed: int = 0,
     contiguous: bool = False,
+    sorted_arm: bool = True,
 ) -> dict:
     """On-device certification of the fused kernel against the XLA segment
     ops: forward + gradient parity on the PNA aggregation workload (reference
@@ -657,6 +723,76 @@ def certify_pallas(
 
         pallas_ms = best_ms(f_fused)
         xla_ms = best_ms(f_xla)
+
+        # Third arm on contiguous ids: the scatter-free sorted path
+        # (ops/segment_sorted.py). Measured UNMASKED — certify's random mask
+        # violates the sorted contract (masked rows must target padding
+        # segments), so its accuracy is checked against its own f64 truth.
+        # Forward AND gradient, like the other two arms.
+        sorted_res = None
+        if contiguous and sorted_arm:
+            _saved_srt = os.environ.get("HYDRAGNN_SEGMENT_SORTED")
+            os.environ["HYDRAGNN_SEGMENT_SORTED"] = "1"
+            try:
+                f_srt = jax.jit(
+                    lambda d: fused_segment_stats(d, ids, n, sorted_ids=True)
+                )
+
+                def _srt_scalar(d):
+                    total, mean, std, _ = fused_segment_stats(
+                        d, ids, n, sorted_ids=True
+                    )
+                    return jnp.sum(total * 0.3 + mean * 1.7 - std * 0.9)
+
+                g_srt = jax.jit(jax.grad(_srt_scalar))
+                outs = jax.block_until_ready(f_srt(data))
+                grad = jax.block_until_ready(g_srt(data))
+                d64 = np.asarray(data, np.float64)
+                ids_h = np.asarray(ids)
+                tot64 = np.zeros((n, f))
+                np.add.at(tot64, ids_h, d64)
+                cnt64 = np.bincount(ids_h, minlength=n).astype(np.float64)
+                safe64 = np.maximum(cnt64, 1.0)[:, None]
+                mean64 = tot64 / safe64
+                sq64 = np.zeros((n, f))
+                np.add.at(sq64, ids_h, np.square(d64 - mean64[ids_h]))
+                std64 = np.sqrt(sq64 / safe64 + 1e-5)
+                truths = (tot64, mean64, std64, cnt64)
+                err = max(
+                    float(np.max(np.abs(np.asarray(o, np.float64) - t)))
+                    for o, t in zip(outs, truths)
+                )
+                # Same cotangent as the other arms' scalarize; dstd at
+                # single-count segments is identically 0 (std pinned there).
+                per_lin = 0.3 + 1.7 / safe64
+                quad = np.where(
+                    cnt64[:, None] > 1.0, -0.9 / (std64 * safe64), 0.0
+                )
+                g64 = per_lin[ids_h] + quad[ids_h] * (d64 - mean64[ids_h])
+                err_grad = float(
+                    np.max(np.abs(np.asarray(grad, np.float64) - g64))
+                )
+                sorted_ms = best_ms(f_srt)
+                # Gradient gate: no regression vs the INCUMBENT default (the
+                # XLA bundle) rather than the kernel-grade 5e-4 — the sorted
+                # std grad inherits ~1/std^2 amplification at near-degenerate
+                # segments from its ~1e-5 sumsq noise (measured ~5e-3), while
+                # the XLA path production trains on today carries ~9e-2 from
+                # its E[x^2]-E[x]^2 cancellation. Promotion must not lose
+                # accuracy; it need not beat the Pallas kernel's.
+                sorted_res = {
+                    "sorted_ms": round(sorted_ms, 4),
+                    "sorted_err_fwd": err,
+                    "sorted_err_grad": err_grad,
+                    "sorted_ok": err < 5e-4
+                    and err_grad <= max(5e-4, xla_err_grad),
+                    "sorted_speedup_vs_xla": round(sorted_ms and xla_ms / sorted_ms, 3),
+                }
+            finally:
+                if _saved_srt is None:
+                    os.environ.pop("HYDRAGNN_SEGMENT_SORTED", None)
+                else:
+                    os.environ["HYDRAGNN_SEGMENT_SORTED"] = _saved_srt
     finally:
         if _saved_env is None:
             os.environ.pop("HYDRAGNN_PALLAS", None)
@@ -682,6 +818,7 @@ def certify_pallas(
         "pallas_ms": round(pallas_ms, 4),
         "xla_ms": round(xla_ms, 4),
         "speedup": round(xla_ms / pallas_ms, 3),
+        **(sorted_res or {}),
     }
 
 
@@ -698,25 +835,45 @@ def _flatten_trailing(data):
 
 
 def fused_segment_sum(
-    data, segment_ids, num_segments: int, mask=None, axis_name=None
+    data, segment_ids, num_segments: int, mask=None, axis_name=None,
+    sorted_ids: bool = False,
 ):
     """Drop-in masked ``segment_sum`` used by every conv family's aggregation:
-    the one-hot MXU kernel when opted in (HYDRAGNN_PALLAS=1 — see
-    pallas_enabled for why the default is the XLA path since r05), the masked
-    XLA segment op otherwise. Accepts any [E, ...] float data (trailing dims
-    flattened for the kernel)."""
+    the scatter-free sorted path when the caller guarantees non-decreasing
+    ids AND HYDRAGNN_SEGMENT_SORTED=1, the one-hot MXU kernel when opted in
+    (HYDRAGNN_PALLAS=1 — see pallas_enabled for why the default is the XLA
+    path since r05), the masked XLA segment op otherwise. Accepts any
+    [E, ...] float data (trailing dims flattened for the kernel)."""
     total, _ = fused_segment_sum_count(
-        data, segment_ids, num_segments, mask=mask, axis_name=axis_name
+        data, segment_ids, num_segments, mask=mask, axis_name=axis_name,
+        sorted_ids=sorted_ids,
     )
     return total
 
 
 def fused_segment_sum_count(
-    data, segment_ids, num_segments: int, mask=None, axis_name=None
+    data, segment_ids, num_segments: int, mask=None, axis_name=None,
+    sorted_ids: bool = False,
 ):
     """Masked (segment_sum, segment_count) in ONE fused pass — callers that
     need both (MFC's degree lookup) save a whole scatter. Falls back to the
-    two XLA ops off-TPU."""
+    two XLA ops off-TPU.
+
+    ``sorted_ids=True`` declares the collation contract: non-decreasing ids
+    with masked rows targeting padding segments (whose outputs are unused) —
+    the sorted path's count includes masked rows, which is only correct
+    under that contract."""
+    if sorted_ids and srt.sorted_enabled():
+        flat, unflatten = _flatten_trailing(data)
+        if mask is not None:
+            flat = jnp.where(mask[:, None], flat, 0)
+        total, count = srt.segment_sum_count_sorted(
+            flat.astype(jnp.float32), segment_ids.astype(jnp.int32), num_segments
+        )
+        if axis_name is not None:
+            total = jax.lax.psum(total, axis_name)
+            count = jax.lax.psum(count, axis_name)
+        return unflatten(total.astype(data.dtype)), count
     if not pallas_enabled():
         return (
             seg.segment_sum(
@@ -740,11 +897,21 @@ def fused_segment_sum_count(
 
 
 def fused_segment_mean(
-    data, segment_ids, num_segments: int, mask=None, axis_name=None
+    data, segment_ids, num_segments: int, mask=None, axis_name=None,
+    sorted_ids: bool = False,
 ):
     """Drop-in masked ``segment_mean`` over the fused kernel (SAGE neighbor
     mean, the global mean-pool readout). Both paths return ``data.dtype`` so
     CPU-fallback and TPU runs agree on dtype flow."""
+    if sorted_ids and srt.sorted_enabled():
+        total, count = fused_segment_sum_count(
+            data, segment_ids, num_segments, mask=mask, axis_name=axis_name,
+            sorted_ids=True,
+        )
+        safe = jnp.maximum(count, 1.0).reshape(
+            count.shape + (1,) * (total.ndim - count.ndim)
+        )
+        return (total / safe).astype(data.dtype)
     if not pallas_enabled():
         return seg.segment_mean(
             data, segment_ids, num_segments, mask=mask, axis_name=axis_name
@@ -780,21 +947,23 @@ def pna_aggregate(
     aggregators: Tuple[str, ...],
     mask: Optional[jnp.ndarray] = None,
     axis_name: Optional[str] = None,
+    sorted_ids: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """PNA multi-aggregator bundle → (stacked [N, A, F] aggregates, count [N]).
 
-    Routes the sum/mean/std family through the fused Pallas kernel when
-    enabled; min/max always via XLA segment extrema. Falls back entirely to
-    the masked XLA segment ops off-TPU.
+    Routes the sum/mean/std family through the scatter-free sorted path or
+    the fused Pallas kernel when enabled; min/max always via XLA segment
+    extrema. Falls back entirely to the masked XLA segment ops otherwise.
     """
     n = num_segments
-    if pallas_enabled():
+    use_sorted = sorted_ids and srt.sorted_enabled()
+    if pallas_enabled() or use_sorted:
         fused = {}
         count = None
         if any(a in ("mean", "std", "sum") for a in aggregators):
             total, mean, std, count = fused_segment_stats(
                 msg, receivers, n, mask=mask, axis_name=axis_name,
-                want_std="std" in aggregators,
+                want_std="std" in aggregators, sorted_ids=sorted_ids,
             )
             fused = {"mean": mean, "std": std, "sum": total}
         if "min" in aggregators or "max" in aggregators:

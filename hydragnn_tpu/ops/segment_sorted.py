@@ -1,0 +1,175 @@
+"""Scatter-free segment aggregation for SORTED segment ids.
+
+Collation owns edge order (message passing is permutation-invariant over
+edges), so GraphArena sorts each graph's edges by receiver once at arena
+build; batch receivers are then globally non-decreasing (per-graph sorted
+runs + ascending node offsets + padding edges at the top index). That turns
+segment_sum — TPU's worst op as a scatter — into pure prefix sums and
+gathers:
+
+    P[k]   = sum(data[:k])                       (compensated prefix, below)
+    out[s] = P[right_s] - P[left_s],   left/right = searchsorted(ids, s)
+    cnt[s] = right_s - left_s                    (EXACT, integer)
+
+Cost: one O(E·F) chunked cumsum (HBM-bound, log-depth on TPU), a short
+TwoSum carry scan over chunk totals, two binary searches [N], two gathers
+[N, F]. Zero MXU work, zero scatter, no O(N·E) one-hot.
+
+Accuracy: a raw f32 prefix difference cancels against the magnitude of the
+WHOLE prefix (worst ~1e-3 at E=16k), so the prefix is two-level: f32 cumsum
+within chunks (error bounded by local magnitudes) and carries accumulated
+across chunks as an UNEVALUATED hi+err pair via error-free TwoSum — no f64,
+so no dependence on jax_enable_x64. The segment value is recovered as
+(hi_r - hi_l) + (err_r - err_l) + (local_r - local_l): the hi cancellation
+is exactly rounded and its accumulated rounding error lives in err.
+Certified against the same f64 ground truth as the Pallas kernel (tests).
+
+OPT-IN (HYDRAGNN_SEGMENT_SORTED=1) until measured on TPU hardware — the
+sorted arm rides along automatically whenever ``certify_pallas`` runs on
+contiguous ids (bench.py each round; benchmarks/tune_kernel.py's first sweep
+arm; benchmarks/hw_watchdog.sh's bench_sorted step measures it in the real
+train step). Convs request it via ``sorted_ids=True`` on the fused_*
+wrappers (GAT's self-loop concat breaks sortedness and never does).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sorted_enabled() -> bool:
+    """Trace-time gate, like HYDRAGNN_PALLAS (set before the first step)."""
+    return os.environ.get("HYDRAGNN_SEGMENT_SORTED", "0") not in (
+        "0", "false", "False",
+    )
+
+
+def _chunk_rows(e: int) -> int:
+    """Chunk size: >=128 (lane-friendly), sized so the carry scan stays short
+    (<=512 sequential steps) while local f32 cumsum error stays bounded."""
+    c = 128
+    while e // c > 512:
+        c *= 2
+    return c
+
+
+def _two_sum(a, b):
+    """Error-free transformation: a + b = s + err exactly (Knuth)."""
+    s = a + b
+    bb = s - a
+    err = (a - bb) + (b - (s - bb))
+    return s, err
+
+
+def _prefix_open(data32: jnp.ndarray):
+    """Two-level inclusive prefix of [E, F] f32 data.
+
+    Returns (local, hi, err, chunk): P[k] = hi[k // chunk] + err[k // chunk]
+    + local[k], where (hi, err) is the compensated EXCLUSIVE sum of chunks
+    before k's and local the f32 cumsum inside it."""
+    e, f = data32.shape
+    chunk = _chunk_rows(e)
+    e_pad = (e + chunk - 1) // chunk * chunk
+    padded = jnp.zeros((e_pad, f), jnp.float32).at[:e].set(data32)
+    chunks = padded.reshape(e_pad // chunk, chunk, f)
+    local = jnp.cumsum(chunks, axis=1)
+    totals = local[:, -1, :]  # [C, F]
+
+    def step(carry, t):
+        s, err = carry
+        s2, e2 = _two_sum(s, t)
+        return (s2, err + e2), (s, err)  # emit EXCLUSIVE prefix
+
+    zeros = jnp.zeros((f,), jnp.float32)
+    _, (hi, err) = jax.lax.scan(step, (zeros, zeros), totals)
+    return local.reshape(e_pad, f), hi, err, chunk
+
+
+def _sum_count_sorted(data, ids, num_segments: int):
+    ids = ids.astype(jnp.int32)
+    data32 = data.astype(jnp.float32)
+    if data32.shape[0] == 0:
+        # Drop-in parity with segment_sum on an empty edge set: exact zeros
+        # (jnp.mean over the empty axis would otherwise inject NaN via mu).
+        return (
+            jnp.zeros((num_segments, data32.shape[1]), jnp.float32),
+            jnp.zeros((num_segments,), jnp.float32),
+        )
+    # Mean-center before the prefix: a mean-shifted stream grows the prefix
+    # linearly and the within-chunk f32 cumsum rounds at ulp(prefix) — ~5e-4
+    # absolute at E=16k, 100x the scatter path. Centered, the prefix is a
+    # random walk (~sqrt scale); the exact row count restores count*mu after
+    # the difference (masked rows contribute -mu then get +mu back: net 0).
+    mu = jnp.mean(data32, axis=0)
+    local, hi, err, chunk = _prefix_open(data32 - mu)
+    seg = jnp.arange(num_segments, dtype=jnp.int32)
+    left = jnp.searchsorted(ids, seg, side="left").astype(jnp.int32)
+    right = jnp.searchsorted(ids, seg, side="right").astype(jnp.int32)
+
+    def parts(k):
+        """(hi, err, local) components of P[k] = sum(data[:k]); k in [0, E]."""
+        km1 = jnp.maximum(k - 1, 0)
+        nz = (k > 0)[:, None]
+        c = km1 // chunk
+        return (
+            jnp.where(nz, hi[c], 0.0),
+            jnp.where(nz, err[c], 0.0),
+            jnp.where(nz, local[km1], 0.0),
+        )
+
+    hi_r, err_r, loc_r = parts(right)
+    hi_l, err_l, loc_l = parts(left)
+    # hi_r - hi_l is exactly rounded; the carries' accumulated rounding error
+    # is (err_r - err_l); within-chunk contributions cancel at local scale.
+    count = (right - left).astype(jnp.float32)
+    total = (
+        (hi_r - hi_l) + (err_r - err_l) + (loc_r - loc_l)
+        + count[:, None] * mu
+    )
+    return total, count
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def segment_sum_count_sorted(data, ids, num_segments: int):
+    """(segment_sum, segment_count) for non-decreasing ``ids`` — see module
+    docstring. ``data`` [E, F] float; masked rows must already be zeroed and
+    their ids kept sort-compatible (collation's padding contract)."""
+    return _sum_count_sorted(data, ids, num_segments)
+
+
+def _fwd(data, ids, num_segments):
+    # Zero-size carrier keeps the input dtype in the residuals (a raw dtype
+    # object is not a JAX type) — same trick as pallas_segment's VJP.
+    carrier = jnp.zeros((0,), data.dtype)
+    return _sum_count_sorted(data, ids, num_segments), (ids, carrier)
+
+
+def _bwd(num_segments, res, cots):
+    ids, carrier = res
+    d_total, _ = cots  # count is effectively non-differentiable (integer)
+    idx = jnp.clip(ids.astype(jnp.int32), 0, num_segments - 1)
+    d_data = jnp.take(d_total, idx, axis=0).astype(carrier.dtype)
+    return d_data, jnp.zeros(ids.shape, jax.dtypes.float0)
+
+
+segment_sum_count_sorted.defvjp(_fwd, _bwd)
+
+
+def segment_sum_sorted(
+    data, ids, num_segments: int, mask: Optional[jnp.ndarray] = None
+):
+    """Masked drop-in segment_sum for sorted ids ([E, ...] data)."""
+    shape = data.shape
+    flat = data.reshape(shape[0], -1) if data.ndim != 2 else data
+    if mask is not None:
+        flat = jnp.where(mask[:, None], flat, 0)
+    total, _ = segment_sum_count_sorted(flat, ids, num_segments)
+    out = total.astype(data.dtype)
+    if data.ndim != 2:
+        out = out.reshape((num_segments,) + shape[1:])
+    return out

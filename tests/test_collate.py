@@ -104,14 +104,21 @@ def pytest_vectorized_collate_matches_per_sample_unpack():
         )
         assert (batch.node_graph[node_off:node_off + n] == gi).all()
         if e:
+            # GraphArena stable-sorts each graph's edges by receiver (the
+            # sorted-segment-path contract); the reference expectation gets
+            # the same permutation. Edge ORDER is semantically free.
+            order = np.argsort(s.edge_index[1], kind="stable")
             np.testing.assert_array_equal(
-                batch.senders[edge_off:edge_off + e], s.edge_index[0] + node_off
+                batch.senders[edge_off:edge_off + e],
+                s.edge_index[0][order] + node_off,
             )
             np.testing.assert_array_equal(
-                batch.receivers[edge_off:edge_off + e], s.edge_index[1] + node_off
+                batch.receivers[edge_off:edge_off + e],
+                s.edge_index[1][order] + node_off,
             )
             np.testing.assert_array_equal(
-                batch.edge_features[edge_off:edge_off + e], s.edge_attr[:, :1]
+                batch.edge_features[edge_off:edge_off + e],
+                s.edge_attr[order][:, :1],
             )
         per_head = unpack_targets(s, head_types, head_dims)
         np.testing.assert_allclose(batch.targets[0][gi], per_head[0])
