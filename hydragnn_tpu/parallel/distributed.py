@@ -236,6 +236,17 @@ def get_device_list():
     return jax.local_devices()
 
 
+def config_graph_axis(config: dict) -> int:
+    """The JSON config's edge-sharding request — ``Training.graph_axis``
+    (>1 shards each graph's edges over that many devices; absent/falsy means
+    1). ONE definition consumed by run_training AND run_prediction so the
+    same config can never build different meshes for the two."""
+    return int(
+        config.get("NeuralNetwork", {}).get("Training", {}).get("graph_axis", 1)
+        or 1
+    )
+
+
 def make_mesh(
     data_axis: Optional[int] = None,
     graph_axis: int = 1,

@@ -36,12 +36,16 @@ def _(config_file: str, mesh=None):
 def _(config: dict, mesh=None):
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
     world_size, _rank = setup_ddp()
-    if mesh is None and world_size > 1:
-        # Same auto data-parallel rule as run_training: multi-process launches
-        # evaluate through the global data mesh.
+    from .parallel.distributed import config_graph_axis
+
+    graph_axis = config_graph_axis(config)
+    if mesh is None and (world_size > 1 or graph_axis > 1):
+        # Same auto rule as run_training: multi-process launches evaluate
+        # through the global data mesh; Training.graph_axis > 1 additionally
+        # shards each graph's edges (config-level large-graph support).
         from .parallel.distributed import make_mesh
 
-        mesh = make_mesh()
+        mesh = make_mesh(graph_axis=graph_axis)
 
     train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
         config=config

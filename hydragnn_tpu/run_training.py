@@ -52,14 +52,21 @@ def _(config: dict, mesh=None):
     # jax.distributed.initialize must run first).
     world_size, world_rank = setup_ddp()
     setup_log(get_log_name_config(config))
-    if mesh is None and world_size > 1:
+    # Config-level mesh request (beyond-reference): Training.graph_axis > 1
+    # shards each graph's edges over that many devices (the FeSi_1024-style
+    # large-graph axis) without any programmatic mesh plumbing — pure-JSON
+    # configs reach the same path tests/test_largegraph.py exercises.
+    from .parallel.distributed import config_graph_axis
+
+    graph_axis = config_graph_axis(config)
+    if mesh is None and (world_size > 1 or graph_axis > 1):
         # Reference semantics: training is data-parallel whenever the process
         # group is initialized (DDP wrap, reference run_training.py:78 +
         # distributed.py:216-226) — a multi-process launch without an explicit
         # mesh gets the global data mesh automatically.
         from .parallel.distributed import make_mesh
 
-        mesh = make_mesh()
+        mesh = make_mesh(graph_axis=graph_axis)
 
     verbosity = config["Verbosity"]["level"]
     train_loader, val_loader, test_loader, sampler_list = (
