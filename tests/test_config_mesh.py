@@ -14,7 +14,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import hydragnn_tpu
-from tests.test_graphs import ensure_raw_datasets
+from tests.test_graphs import ensure_raw_datasets, load_ci_config
 
 
 @pytest.mark.mpi_skip
@@ -24,23 +24,10 @@ def pytest_config_graph_axis_trains_and_predicts():
     if len(jax.devices()) < 2:
         pytest.skip("needs >= 2 (virtual) devices")
     os.environ["SERIALIZED_DATA_PATH"] = os.getcwd()
-    with open(os.path.join(os.getcwd(), "tests/inputs", "ci.json")) as f:
-        config = json.load(f)
-    config["NeuralNetwork"]["Architecture"]["model_type"] = "SAGE"
+    config = load_ci_config("ci.json", "SAGE")
     training = config["NeuralNetwork"]["Training"]
     training["num_epoch"] = 2
     training["graph_axis"] = 2  # the knob under test
-    for name in list(config["Dataset"]["path"]):
-        suffix = "" if name == "total" else "_" + name
-        pkl = (
-            os.environ["SERIALIZED_DATA_PATH"]
-            + "/serialized_dataset/"
-            + config["Dataset"]["name"]
-            + suffix
-            + ".pkl"
-        )
-        if os.path.exists(pkl):
-            config["Dataset"]["path"][name] = pkl
     ensure_raw_datasets(config)
 
     hydragnn_tpu.run_training(config)

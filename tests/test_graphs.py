@@ -34,22 +34,7 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
     os.environ["SERIALIZED_DATA_PATH"] = os.getcwd()
 
     config_file = os.path.join(os.getcwd(), "tests/inputs", ci_input)
-    with open(config_file, "r") as f:
-        config = json.load(f)
-    config["NeuralNetwork"]["Architecture"]["model_type"] = model_type
-
-    # Reuse serialized pkl fixtures when present (reference test_graphs.py:43-61).
-    for dataset_name in list(config["Dataset"]["path"].keys()):
-        suffix = "" if dataset_name == "total" else "_" + dataset_name
-        pkl_file = (
-            os.environ["SERIALIZED_DATA_PATH"]
-            + "/serialized_dataset/"
-            + config["Dataset"]["name"]
-            + suffix
-            + ".pkl"
-        )
-        if os.path.exists(pkl_file):
-            config["Dataset"]["path"][dataset_name] = pkl_file
+    config = load_ci_config(ci_input, model_type)
 
     # MFC favors graph-level over node-level heads; bump the graph weight down
     # (reference test_graphs.py:63-66).
@@ -98,6 +83,30 @@ def unittest_train_model(model_type, ci_input, use_lengths, overwrite_data=False
     assert error < thresholds[model_type][0], (
         "Total RMSE checking failed!" + str(error)
     )
+
+
+def load_ci_config(ci_input, model_type=None):
+    """Load a tests/inputs config, set the model family, and substitute the
+    serialized pkl fixtures when present (reference test_graphs.py:43-61).
+    ONE copy of the '/serialized_dataset/<name><suffix>.pkl' rewrite rule,
+    shared by every suite that reuses the CI fixtures."""
+    with open(os.path.join(os.getcwd(), "tests/inputs", ci_input)) as f:
+        config = json.load(f)
+    if model_type is not None:
+        config["NeuralNetwork"]["Architecture"]["model_type"] = model_type
+    root = os.environ.get("SERIALIZED_DATA_PATH", os.getcwd())
+    for dataset_name in list(config["Dataset"]["path"].keys()):
+        suffix = "" if dataset_name == "total" else "_" + dataset_name
+        pkl_file = (
+            root
+            + "/serialized_dataset/"
+            + config["Dataset"]["name"]
+            + suffix
+            + ".pkl"
+        )
+        if os.path.exists(pkl_file):
+            config["Dataset"]["path"][dataset_name] = pkl_file
+    return config
 
 
 def ensure_raw_datasets(config, num_samples_tot=500):

@@ -25,7 +25,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import hydragnn_tpu
-from tests.test_graphs import THRESHOLDS, ensure_raw_datasets
+from tests.test_graphs import THRESHOLDS, ensure_raw_datasets, load_ci_config
 
 SCATTER_ALLOWANCE = 1.05
 
@@ -34,20 +34,7 @@ SCATTER_ALLOWANCE = 1.05
 def pytest_pna_multihead_converges_under_pallas(monkeypatch):
     monkeypatch.setenv("HYDRAGNN_PALLAS", "1")
     os.environ["SERIALIZED_DATA_PATH"] = os.getcwd()
-    with open(os.path.join(os.getcwd(), "tests/inputs", "ci_multihead.json")) as f:
-        config = json.load(f)
-    config["NeuralNetwork"]["Architecture"]["model_type"] = "PNA"
-    for name in list(config["Dataset"]["path"]):
-        suffix = "" if name == "total" else "_" + name
-        pkl = (
-            os.environ["SERIALIZED_DATA_PATH"]
-            + "/serialized_dataset/"
-            + config["Dataset"]["name"]
-            + suffix
-            + ".pkl"
-        )
-        if os.path.exists(pkl):
-            config["Dataset"]["path"][name] = pkl
+    config = load_ci_config("ci_multihead.json", "PNA")
     ensure_raw_datasets(config)
 
     hydragnn_tpu.run_training(config)
