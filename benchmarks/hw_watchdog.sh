@@ -92,12 +92,27 @@ profile_axon() {
   timeout 2400 python benchmarks/profile_epoch.py --platform axon --out PROFILE_r05.json
 }
 
+matrix_tpu() {
+  # Flagship convergence cell ON HARDWARE (VERDICT r04 item 3's "and, when
+  # reachable, TPU" clause): PNA + ci_multihead under the real kernel.
+  # Outer timeout > the script's per-child 3600s so its own child-timeout
+  # handling (record the cell, write the artifact) can run.
+  HYDRAGNN_MATRIX_TPU=1 timeout 3900 python benchmarks/pallas_matrix.py \
+    --families PNA --configs ci_multihead.json \
+    --out PALLAS_MATRIX_TPU_r05.json
+  local rc=$?
+  # An artifact whose cells all errored is not a landed measurement.
+  grep -q '"rmse"' PALLAS_MATRIX_TPU_r05.json 2>/dev/null || return 1
+  return $rc
+}
+
 while true; do
   if [ -f "$MARK/bench_default" ] && [ -f "$MARK/bench_pallas" ] \
      && [ -f "$MARK/bench_sorted" ] \
-     && [ -f "$MARK/certify" ] && [ -f "$MARK/tune" ] && [ -f "$MARK/profile" ]; then
+     && [ -f "$MARK/certify" ] && [ -f "$MARK/tune" ] && [ -f "$MARK/profile" ] \
+     && [ -f "$MARK/matrix_tpu" ]; then
     echo "=== all hardware steps complete $(date -u +%FT%TZ) ===" >> "$LOG"
-    record_probe "done" "watchdog: all 6 hardware artifacts landed"
+    record_probe "done" "watchdog: all 7 hardware artifacts landed"
     exit 0
   fi
   if probe; then
@@ -112,6 +127,7 @@ while true; do
     probe && step bench_sorted bench_sorted
     probe && step tune tune
     probe && step profile profile_axon
+    probe && step matrix_tpu matrix_tpu
   else
     # Throttle dead-tunnel records to ~1/hour so the probe log stays readable.
     FAILS=$((FAILS + 1))

@@ -107,6 +107,11 @@ def main():
     ap.add_argument("--out", default=os.path.join(REPO, "PALLAS_MATRIX_r05.json"))
     ap.add_argument("--configs", default="ci.json,ci_multihead.json")
     ap.add_argument(
+        "--families", default=",".join(FAMILIES),
+        help="comma-separated subset (e.g. just PNA for the flagship cell "
+        "on scarce TPU-tunnel time)",
+    )
+    ap.add_argument(
         "--scatter", type=int, default=0,
         help="also re-measure PNA+ci_multihead across N extra seeds per path",
     )
@@ -118,8 +123,12 @@ def main():
         "env": "HYDRAGNN_PALLAS=1 (interpreter off-TPU, real kernel on TPU)",
         "matrix": [],
     }
+    families = [f.strip() for f in args.families.split(",") if f.strip()]
+    unknown = set(families) - set(FAMILIES)
+    if unknown:
+        sys.exit(f"unknown families: {sorted(unknown)}")
     for ci_input in args.configs.split(","):
-        for family in FAMILIES:
+        for family in families:
             r = _run_one(family, ci_input, 0, pallas=True)
             gate = thresholds[family][0]
             row = {"family": family, "config": ci_input, "gate_rmse": gate}
