@@ -18,13 +18,23 @@ def _random_problem(rng, e=300, n=40, f=17):
     return data, ids, mask, n
 
 
+# Kernel-vs-XLA value tolerance: the split path rounds the lo residual to
+# bf16 explicitly (hardware-faithful — the MXU truncates f32 operands to bf16
+# at DEFAULT dot precision), so the interpreter now shows the genuine bf16x2
+# error ~ sum_k |x_k|*2^-17 per segment instead of exact f32. 3e-4 bounds
+# that for every problem in this file and stays below the 5e-4 certification
+# gate certify_pallas enforces.
+_ATOL = 3e-4
+_RTOL = 1e-4
+
+
 def pytest_sum_count_match_xla():
     rng = np.random.default_rng(0)
     data, ids, mask, n = _random_problem(rng)
     masked_ids = jnp.where(mask, ids, -1)
     s, c = ps.segment_sum_count(data, masked_ids, n, True)
     np.testing.assert_allclose(
-        s, seg.segment_sum(data, ids, n, mask=mask), rtol=1e-5, atol=1e-5
+        s, seg.segment_sum(data, ids, n, mask=mask), rtol=_RTOL, atol=_ATOL
     )
     np.testing.assert_allclose(c, seg.segment_count(ids, n, mask=mask), rtol=1e-6)
 
@@ -46,13 +56,13 @@ def pytest_fused_stats_match_xla():
         data, ids, n, mask=mask, interpret=True
     )
     np.testing.assert_allclose(
-        total, seg.segment_sum(data, ids, n, mask=mask), rtol=1e-5, atol=1e-5
+        total, seg.segment_sum(data, ids, n, mask=mask), rtol=_RTOL, atol=_ATOL
     )
     np.testing.assert_allclose(
-        mean, seg.segment_mean(data, ids, n, mask=mask), rtol=1e-5, atol=1e-5
+        mean, seg.segment_mean(data, ids, n, mask=mask), rtol=_RTOL, atol=_ATOL
     )
     np.testing.assert_allclose(
-        std, seg.segment_std(data, ids, n, mask=mask), rtol=1e-4, atol=1e-4
+        std, seg.segment_std(data, ids, n, mask=mask), rtol=_RTOL, atol=_ATOL
     )
     np.testing.assert_allclose(count, seg.segment_count(ids, n, mask=mask), rtol=1e-6)
 
@@ -140,12 +150,12 @@ def pytest_fused_dropin_wrappers_match_xla(monkeypatch):
     np.testing.assert_allclose(
         ps.fused_segment_sum(data, ids, n, mask=mask),
         seg.segment_sum(data, ids, n, mask=mask),
-        rtol=1e-5, atol=1e-5,
+        rtol=_RTOL, atol=_ATOL,
     )
     np.testing.assert_allclose(
         ps.fused_segment_mean(data, ids, n, mask=mask),
         seg.segment_mean(data, ids, n, mask=mask),
-        rtol=1e-5, atol=1e-5,
+        rtol=_RTOL, atol=_ATOL,
     )
 
     # 3-D (GAT multi-head messages [E, h, f]); no mask.
@@ -154,7 +164,7 @@ def pytest_fused_dropin_wrappers_match_xla(monkeypatch):
     np.testing.assert_allclose(
         ps.fused_segment_sum(d3, ids3, 10),
         seg.segment_sum(d3, ids3, 10),
-        rtol=1e-5, atol=1e-5,
+        rtol=_RTOL, atol=_ATOL,
     )
 
     # bf16 in → bf16 out (mixed-precision dtype flow preserved).
@@ -228,10 +238,10 @@ def pytest_packed_split_boundary_matches_unpacked():
         ids = jnp.asarray(rng.integers(0, 40, size=300).astype(np.int32))
         s_split, c_split = ps.segment_sum_count(data, ids, 40, True, split=True)
         ref = seg.segment_sum(data, ids, 40)
-        # (In the CPU interpreter the matmul is already exact f32, so only
-        # parity — not accuracy ordering vs split=False — is checkable here;
-        # certify_pallas measures the real-bf16 accuracy on TPU.)
-        np.testing.assert_allclose(s_split, ref, rtol=1e-6, atol=1e-5)
+        # The split path rounds lo to bf16 (hardware-faithful), so the
+        # interpreter shows the genuine bf16x2 error here too — same bound
+        # as the rest of the file.
+        np.testing.assert_allclose(s_split, ref, rtol=_RTOL, atol=_ATOL)
         np.testing.assert_allclose(c_split, seg.segment_count(ids, 40), rtol=1e-6)
 
 
@@ -254,7 +264,7 @@ def pytest_be_override_parity(monkeypatch):
     try:
         assert ps._BE == 256
         s, c = ps.segment_sum_count(data, ids, 50, True)
-        np.testing.assert_allclose(s, want, rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(s, want, rtol=_RTOL, atol=_ATOL)
         np.testing.assert_allclose(c, seg.segment_count(ids, 50), rtol=1e-6)
     finally:
         # Restore the AMBIENT env (monkeypatch teardown will do the same for
@@ -316,10 +326,10 @@ def pytest_block_skip_full_stats_and_model_path(monkeypatch):
         data, ids, n, mask=mask, interpret=True
     )
     np.testing.assert_allclose(
-        total, seg.segment_sum(data, ids, n, mask=mask), rtol=1e-5, atol=1e-5
+        total, seg.segment_sum(data, ids, n, mask=mask), rtol=_RTOL, atol=_ATOL
     )
     np.testing.assert_allclose(
-        std, seg.segment_std(data, ids, n, mask=mask), rtol=1e-4, atol=1e-4
+        std, seg.segment_std(data, ids, n, mask=mask), rtol=_RTOL, atol=_ATOL
     )
     np.testing.assert_allclose(count, seg.segment_count(ids, n, mask=mask), rtol=1e-6)
 
@@ -327,3 +337,22 @@ def pytest_block_skip_full_stats_and_model_path(monkeypatch):
     s, c = ps.segment_sum_count(data, jnp.full((900,), -1, jnp.int32), n, True)
     np.testing.assert_array_equal(c, np.zeros(n))
     np.testing.assert_array_equal(s, np.zeros((n, 6)))
+
+
+def pytest_interpreter_certification_is_hardware_faithful():
+    """Regression for the r05 on-hardware certification failure (ok=false at
+    every block size while the interpreter passed): DEFAULT-precision MXU
+    dots truncate f32 operands to bf16 on the chip but not in the
+    interpreter. Two fixes make the interpreter predictive: the lo residual
+    is explicitly bf16-rounded before packing (so the dot is exact on both
+    platforms), and the std's sum-of-squares pass takes the hi/lo split
+    (single-pass bf16 squares carried ~8e-3 error — 16x the gate). With
+    both, certification must pass in the interpreter on the same 5e-4 gate
+    the hardware run enforces."""
+    import pytest
+
+    report = ps.certify_pallas(e=2048, f=24, n=256, reps=1, sorted_arm=False)
+    if report["backend"] == "tpu":  # hardware suite (HYDRAGNN_TPU_TESTS=1):
+        pytest.skip("interpreter semantics under test; TPU covered by "
+                    "tests/test_pallas_tpu.py")
+    assert report["ok"], report
