@@ -192,7 +192,10 @@ def _peak_workload():
     }
 
 
-def build_production_pipeline(batch_size: "int | None" = None) -> dict:
+def build_production_pipeline(
+    batch_size: "int | None" = None,
+    training_overrides: "dict | None" = None,
+) -> dict:
     """ci_multihead.json (the north-star multi-task config) through the real
     pipeline: serialized dataset -> bucketed loader (2 shape buckets) ->
     config completion -> model -> TrainingDriver. ONE implementation shared
@@ -243,6 +246,8 @@ def build_production_pipeline(batch_size: "int | None" = None) -> dict:
     config["Dataset"]["num_buckets"] = 2
     if batch_size is not None:
         config["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    if training_overrides:
+        config["NeuralNetwork"]["Training"].update(training_overrides)
 
     train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
         config=config
@@ -323,6 +328,35 @@ def _production_workload():
     }
 
 
+def _cached_epoch_workload(epochs: int = 8) -> dict:
+    """The device-resident production path: same pipeline as
+    _production_workload but with Training.reshuffle="batch", so after the
+    first epoch the stacked chunks live on device and steady-state epochs do
+    no host collation and no host->device transfer (the dominant cost when
+    the chip is reached through a tunnel). Reported as its own metric
+    alongside — never instead of — the parity-semantics bucketed number."""
+    pipe = build_production_pipeline(training_overrides={"reshuffle": "batch"})
+    driver = pipe["driver"]
+    bucketed = pipe["train_loader"]
+    first_s = steady_s = 0.0
+    for epoch in range(epochs):
+        bucketed.set_epoch(epoch)
+        t0 = time.perf_counter()
+        driver.train_epoch(bucketed)
+        dt = time.perf_counter() - t0
+        if epoch == 0:
+            first_s = dt  # compile + cache build
+        else:
+            steady_s += dt
+    n_train = len(bucketed.dataset)
+    return {
+        "bucketed_throughput_cached": round(
+            n_train * (epochs - 1) / steady_s, 2
+        ),
+        "cached_first_epoch_s": round(first_s, 3),
+    }
+
+
 def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
     'remote_compile: Connection refused') or probe timeouts — retryable;
@@ -398,6 +432,11 @@ def main():
             result["value"] / BASELINE_GRAPHS_PER_SEC, 3
         )
         result.update(_with_retries(_production_workload))
+        # Device-resident variant (Training.reshuffle="batch") — non-fatal.
+        try:
+            result.update(_with_retries(_cached_epoch_workload))
+        except Exception as e:
+            result["bucketed_cached_error"] = f"{type(e).__name__}: {e}"
         if jax.default_backend() == "tpu":
             # Hardware-meaningful MFU (see _mfu_workload) — non-fatal.
             try:

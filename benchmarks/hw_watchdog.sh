@@ -54,17 +54,28 @@ bench_default() {
 }
 
 bench_pallas() {
-  # The opt-in kernel arm of the A/B (the default path is XLA since the r05
-  # gating flip; bench_default covers it).
-  HYDRAGNN_PALLAS=1 timeout 2400 python bench.py > /tmp/bench_r05_pallas.out
+  # The kernel arm. SORTED pinned OFF: the sorted path defaults ON for TPU
+  # (it would otherwise shadow the kernel in every conv family).
+  HYDRAGNN_PALLAS=1 HYDRAGNN_SEGMENT_SORTED=0 timeout 2400 python bench.py > /tmp/bench_r05_pallas.out
   local rc=$?
   tail -1 /tmp/bench_r05_pallas.out > BENCH_r05_pallas.json
   grep -q '"error"' BENCH_r05_pallas.json && return 1
   return $rc
 }
 
+bench_xla() {
+  # The pre-r05 default (XLA scatter bundle) — the baseline pin's own path,
+  # kept measured now that the production default is the sorted path.
+  HYDRAGNN_SEGMENT_SORTED=0 timeout 2400 python bench.py > /tmp/bench_r05_xla.out
+  local rc=$?
+  tail -1 /tmp/bench_r05_xla.out > BENCH_r05_xla.json
+  grep -q '"error"' BENCH_r05_xla.json && return 1
+  return $rc
+}
+
 bench_sorted() {
-  # Third arm: the scatter-free sorted-segment path in the REAL train step.
+  # The scatter-free sorted-segment path in the REAL train step (now also
+  # the TPU default; kept as an explicit arm for labeling).
   HYDRAGNN_SEGMENT_SORTED=1 timeout 2400 python bench.py > /tmp/bench_r05_sorted.out
   local rc=$?
   tail -1 /tmp/bench_r05_sorted.out > BENCH_r05_sorted.json
@@ -98,7 +109,7 @@ matrix_tpu() {
   # Outer timeout > the script's per-child 3600s so its own child-timeout
   # handling (record the cell, write the artifact) can run.
   HYDRAGNN_MATRIX_TPU=1 timeout 3900 python benchmarks/pallas_matrix.py \
-    --families PNA --configs ci_multihead.json \
+    --families PNA --configs ci_multihead.json --arm pallas \
     --out PALLAS_MATRIX_TPU_r05.json
   local rc=$?
   # An artifact whose cells all errored is not a landed measurement.
@@ -106,13 +117,23 @@ matrix_tpu() {
   return $rc
 }
 
+matrix_sorted() {
+  # Flagship convergence cell under the NEW production default (sorted).
+  HYDRAGNN_MATRIX_TPU=1 timeout 3900 python benchmarks/pallas_matrix.py \
+    --families PNA --configs ci_multihead.json --arm sorted \
+    --out PALLAS_MATRIX_SORTED_TPU_r05.json
+  local rc=$?
+  grep -q '"rmse"' PALLAS_MATRIX_SORTED_TPU_r05.json 2>/dev/null || return 1
+  return $rc
+}
+
 while true; do
   if [ -f "$MARK/bench_default" ] && [ -f "$MARK/bench_pallas" ] \
-     && [ -f "$MARK/bench_sorted" ] \
+     && [ -f "$MARK/bench_sorted" ] && [ -f "$MARK/bench_xla" ] \
      && [ -f "$MARK/certify" ] && [ -f "$MARK/tune" ] && [ -f "$MARK/profile" ] \
-     && [ -f "$MARK/matrix_tpu" ]; then
+     && [ -f "$MARK/matrix_tpu" ] && [ -f "$MARK/matrix_sorted" ]; then
     echo "=== all hardware steps complete $(date -u +%FT%TZ) ===" >> "$LOG"
-    record_probe "done" "watchdog: all 7 hardware artifacts landed"
+    record_probe "done" "watchdog: all 9 hardware artifacts landed"
     exit 0
   fi
   if probe; then
@@ -125,9 +146,11 @@ while true; do
     probe && step bench_default bench_default
     probe && step bench_pallas bench_pallas
     probe && step bench_sorted bench_sorted
+    probe && step bench_xla bench_xla
     probe && step tune tune
     probe && step profile profile_axon
     probe && step matrix_tpu matrix_tpu
+    probe && step matrix_sorted matrix_sorted
   else
     # Throttle dead-tunnel records to ~1/hour so the probe log stays readable.
     FAILS=$((FAILS + 1))

@@ -78,8 +78,19 @@ def _thresholds():
     return THRESHOLDS
 
 
-def _run_one(model_type, ci_input, seed, pallas):
-    env = dict(os.environ, HYDRAGNN_PALLAS="1" if pallas else "0")
+# Aggregation arms pin BOTH gates: with the sorted path defaulting ON for
+# TPU execution (ops/segment_sorted.sorted_enabled), an arm that set only
+# HYDRAGNN_PALLAS would silently measure the sorted path on hardware.
+_ARMS = {
+    "pallas": {"HYDRAGNN_PALLAS": "1", "HYDRAGNN_SEGMENT_SORTED": "0"},
+    "sorted": {"HYDRAGNN_PALLAS": "0", "HYDRAGNN_SEGMENT_SORTED": "1"},
+    "xla": {"HYDRAGNN_PALLAS": "0", "HYDRAGNN_SEGMENT_SORTED": "0"},
+}
+
+
+def _run_one(model_type, ci_input, seed, pallas=True, arm=None):
+    arm = arm or ("pallas" if pallas else "xla")
+    env = dict(os.environ, **_ARMS[arm])
     child = _CHILD % {"repo": REPO}
     try:
         proc = subprocess.run(
@@ -112,6 +123,11 @@ def main():
         "on scarce TPU-tunnel time)",
     )
     ap.add_argument(
+        "--arm", choices=sorted(_ARMS), default="pallas",
+        help="aggregation path under test (pins HYDRAGNN_PALLAS and "
+        "HYDRAGNN_SEGMENT_SORTED together)",
+    )
+    ap.add_argument(
         "--scatter", type=int, default=0,
         help="also re-measure PNA+ci_multihead across N extra seeds per path",
     )
@@ -120,7 +136,8 @@ def main():
     thresholds = _thresholds()
     out = {
         "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "env": "HYDRAGNN_PALLAS=1 (interpreter off-TPU, real kernel on TPU)",
+        "arm": args.arm,
+        "env": " ".join(f"{k}={v}" for k, v in sorted(_ARMS[args.arm].items())),
         "matrix": [],
     }
     families = [f.strip() for f in args.families.split(",") if f.strip()]
@@ -129,7 +146,7 @@ def main():
         sys.exit(f"unknown families: {sorted(unknown)}")
     for ci_input in args.configs.split(","):
         for family in families:
-            r = _run_one(family, ci_input, 0, pallas=True)
+            r = _run_one(family, ci_input, 0, arm=args.arm)
             gate = thresholds[family][0]
             row = {"family": family, "config": ci_input, "gate_rmse": gate}
             if "error" in r:
@@ -152,10 +169,10 @@ def main():
 
     if args.scatter:
         out["scatter_pna_multihead"] = []
-        for pallas in (False, True):
+        for arm in dict.fromkeys(("xla", args.arm)):  # --arm xla: no dup pass
             for seed in range(args.scatter):
-                r = _run_one("PNA", "ci_multihead.json", seed, pallas)
-                row = {"pallas": pallas, "seed": seed}
+                r = _run_one("PNA", "ci_multihead.json", seed, arm=arm)
+                row = {"arm": arm, "seed": seed}
                 row.update(
                     {"rmse": [round(v, 6) for v in r["rmse"]]}
                     if "rmse" in r

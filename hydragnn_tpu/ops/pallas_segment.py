@@ -46,10 +46,8 @@ Pallas interpreter for exact parity with what compiles on TPU). Set
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import os
-from contextvars import ContextVar
 from typing import Optional, Tuple
 
 import jax
@@ -78,30 +76,11 @@ if _BE_ERROR is None and (_BE <= 0 or _BE % 128 != 0):
         f"HYDRAGNN_PALLAS_BE={_BE} must be a positive multiple of 128 (lanes)"
     )
 
-# Platform the gating decisions see. jax.default_backend() is a process-global
-# property and is WRONG in mixed-platform environments (e.g. a TPU-attached
-# host tracing a step for a CPU-device mesh): the gate must reflect the
-# platform of the devices that will execute the op. Step builders pin it for
-# the duration of tracing via pallas_platform(). ContextVar so concurrent
-# traces for different-platform meshes don't cross-contaminate.
-_PLATFORM_OVERRIDE: ContextVar[Optional[str]] = ContextVar(
-    "hydragnn_pallas_platform", default=None
-)
-
-
-@contextlib.contextmanager
-def pallas_platform(platform: Optional[str]):
-    """Pin the execution platform Pallas gating sees while tracing a step
-    destined for specific devices (e.g. a CPU mesh on a TPU-attached host)."""
-    token = _PLATFORM_OVERRIDE.set(platform)
-    try:
-        yield
-    finally:
-        _PLATFORM_OVERRIDE.reset(token)
-
-
-def _platform() -> str:
-    return _PLATFORM_OVERRIDE.get() or jax.default_backend()
+# Platform gating lives in ops/segment.py (shared with segment_sorted's
+# TPU-default gate — one source of truth, no circular import). Re-exported
+# here under the names the trainer and tests have always used.
+pallas_platform = seg.platform_override
+_platform = seg.execution_platform
 
 
 def pallas_enabled() -> bool:

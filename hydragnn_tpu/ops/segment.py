@@ -15,12 +15,38 @@ edge-partitioned SPMD with one collective per aggregation.
 
 from __future__ import annotations
 
+import contextlib
+from contextvars import ContextVar
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 _BIG = 1e30
+
+# Platform the op-gating decisions see (fused-kernel and sorted-path
+# defaults). jax.default_backend() is process-global and WRONG in
+# mixed-platform environments (a TPU-attached host tracing a step for a CPU
+# mesh): the gate must reflect the devices that will execute the op. Step
+# builders pin it for the duration of tracing via platform_override().
+# Defined here (the lowest-level ops module) so pallas_segment and
+# segment_sorted share one source of truth without a circular import.
+_PLATFORM_OVERRIDE: ContextVar[Optional[str]] = ContextVar(
+    "hydragnn_execution_platform", default=None
+)
+
+
+@contextlib.contextmanager
+def platform_override(platform: Optional[str]):
+    token = _PLATFORM_OVERRIDE.set(platform)
+    try:
+        yield
+    finally:
+        _PLATFORM_OVERRIDE.reset(token)
+
+
+def execution_platform() -> str:
+    return _PLATFORM_OVERRIDE.get() or jax.default_backend()
 
 
 def _pmax(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:

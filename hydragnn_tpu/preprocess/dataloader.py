@@ -39,7 +39,26 @@ class GraphDataLoader:
         head_dims: Optional[Sequence[int]] = None,
         edge_dim: Optional[int] = None,
         num_buckets: int = 1,
+        reshuffle: str = "sample",
     ):
+        """``reshuffle`` picks the per-epoch shuffling granularity:
+
+        - ``"sample"`` (default, reference parity): batch MEMBERSHIP is
+          redrawn every epoch (DistributedSampler ``set_epoch`` semantics) —
+          every epoch re-collates and re-feeds fresh host batches.
+        - ``"batch"``: membership is frozen at epoch 0; epochs reshuffle only
+          the ORDER batches are visited. Collated batches are then cached
+          after the first epoch (and the TrainingDriver additionally caches
+          the stacked epoch chunks on DEVICE), so steady-state epochs do no
+          host collation and no host->device transfer — the win is large
+          when the device link is slow (the tunneled-TPU bucketed path) or
+          the host is collation-bound. A mild SGD semantics change, which is
+          why it is opt-in (``Training.reshuffle`` in the JSON config).
+        """
+        if reshuffle not in ("sample", "batch"):
+            raise ValueError(
+                f"reshuffle must be 'sample' or 'batch', got {reshuffle!r}"
+            )
         self.dataset = list(dataset)
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -49,8 +68,21 @@ class GraphDataLoader:
         self.head_types = tuple(head_types) if head_types else None
         self.head_dims = tuple(head_dims) if head_dims else None
         self.edge_dim = edge_dim
+        self.reshuffle = reshuffle
         self.epoch = 0
         self._arena = None
+        self._frozen_plan = None  # reshuffle="batch": membership drawn once
+        self._batch_cache: dict = {}  # plan position -> collated GraphBatch
+        # Host-RAM cap for the collation cache (padded batches can be several
+        # times the raw dataset): once exceeded, later positions are simply
+        # re-collated each epoch. Distinct from the driver's device-cache
+        # budget (HYDRAGNN_DEVICE_CACHE_MB) — different resource.
+        import os as _os
+
+        self._cache_budget = int(
+            _os.environ.get("HYDRAGNN_HOST_CACHE_MB", "1024")
+        ) * (1 << 20)
+        self._cache_bytes = 0
         self._build_buckets(max(1, int(num_buckets)))
 
     def _build_buckets(self, num_buckets: int) -> None:
@@ -95,6 +127,8 @@ class GraphDataLoader:
         """Called by config completion once output heads are inferred from data."""
         self.head_types = tuple(head_types)
         self.head_dims = tuple(head_dims)
+        self._batch_cache.clear()  # cached collations baked the old spec
+        self._cache_bytes = 0
 
     @property
     def pad_sizes(self):
@@ -121,8 +155,29 @@ class GraphDataLoader:
         return idx
 
     def _batch_plan(self) -> List[tuple]:
-        """[(bucket_id, [sample indices])] for this epoch, batch order shuffled
-        across buckets."""
+        """[(plan_pos, bucket_id, [sample indices])] for this epoch.
+
+        reshuffle="sample": membership redrawn per epoch from
+        rng(seed+epoch); batch order shuffled across buckets.
+        reshuffle="batch": membership drawn ONCE from rng(seed) and frozen
+        (plan_pos is a stable identity — the collation cache and the
+        driver's device cache key on it); only the visit ORDER reshuffles
+        per epoch."""
+        if self.reshuffle == "batch" and self.shuffle:
+            if self._frozen_plan is None:
+                rng = np.random.default_rng(self.seed)
+                plan = []
+                for bi, bucket in enumerate(self._buckets):
+                    idx = self._shard(np.asarray(bucket), rng)
+                    for start in range(0, len(idx), self.batch_size):
+                        plan.append((bi, idx[start : start + self.batch_size]))
+                self._frozen_plan = [
+                    (pos, bi, idx) for pos, (bi, idx) in enumerate(plan)
+                ]
+            order = np.random.default_rng(self.seed + self.epoch).permutation(
+                len(self._frozen_plan)
+            )
+            return [self._frozen_plan[i] for i in order]
         rng = (
             np.random.default_rng(self.seed + self.epoch)
             if self.shuffle
@@ -135,7 +190,7 @@ class GraphDataLoader:
                 plan.append((bi, idx[start : start + self.batch_size]))
         if rng is not None and len(self._buckets) > 1:
             rng.shuffle(plan)
-        return plan
+        return [(None, bi, idx) for bi, idx in plan]
 
     def __len__(self) -> int:
         return len(self._batch_plan())
@@ -146,9 +201,12 @@ class GraphDataLoader:
             # contiguous arenas (the per-sample Python walk in collate_graphs
             # caps a prefetch thread well below TPU consumption rate).
             self._arena = GraphArena(self.dataset)
-        for bi, sample_idx in self._batch_plan():
+        for pos, bi, sample_idx in self._batch_plan():
+            if pos is not None and pos in self._batch_cache:
+                yield self._batch_cache[pos]
+                continue
             n_pad, e_pad, g_pad = self._bucket_pads[bi]
-            yield self._arena.collate(
+            batch = self._arena.collate(
                 sample_idx,
                 head_types=self.head_types or (),
                 head_dims=self.head_dims or (),
@@ -157,3 +215,17 @@ class GraphDataLoader:
                 num_graphs_pad=g_pad,
                 edge_dim=self.edge_dim,
             )
+            if pos is not None:
+                # Frozen membership (reshuffle="batch"): the collation is
+                # deterministic per position, so cache it — up to the host
+                # byte budget. Invalidated when the head spec changes.
+                import jax as _jax
+
+                nbytes = sum(
+                    getattr(l, "nbytes", 0)
+                    for l in _jax.tree_util.tree_leaves(batch)
+                )
+                if self._cache_bytes + nbytes <= self._cache_budget:
+                    self._batch_cache[pos] = batch
+                    self._cache_bytes += nbytes
+            yield batch

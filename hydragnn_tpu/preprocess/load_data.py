@@ -32,10 +32,12 @@ def dataset_loading_and_splitting(config: Dict):
         testset,
         batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
         num_buckets=config["Dataset"].get("num_buckets", 1),
+        reshuffle=config["NeuralNetwork"]["Training"].get("reshuffle", "sample"),
     )
 
 
-def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1):
+def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1,
+                       reshuffle="sample"):
     """Three GraphDataLoaders; multi-process runs shard every split by process
     (the DistributedSampler analog). Returns (train, val, test, sampler_list) for
     reference API parity — the loaders are their own samplers here.
@@ -72,6 +74,11 @@ def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1):
                 # loader may do that — eval loaders keep exact dataset order
                 # (run_prediction rows must align with the test set).
                 num_buckets=num_buckets if shuffle else 1,
+                # Per-epoch reshuffle granularity (Training.reshuffle):
+                # "sample" = reference DistributedSampler parity; "batch"
+                # freezes membership so collation + device transfer cache
+                # across epochs (train loader only — eval never shuffles).
+                reshuffle=reshuffle if shuffle else "sample",
             )
         )
     train_loader, val_loader, test_loader = loaders

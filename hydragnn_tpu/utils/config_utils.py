@@ -32,6 +32,10 @@ _DEFAULTS = (
     (("NeuralNetwork", "Architecture"), "freeze_conv_layers", False),
     (("NeuralNetwork", "Architecture"), "initial_bias", None),
     (("NeuralNetwork", "Training"), "optimizer", "AdamW"),
+    # Per-epoch shuffle granularity: "sample" (reference DistributedSampler
+    # parity) or "batch" (frozen membership; enables collation + device
+    # batch caching across epochs — see preprocess/dataloader.py).
+    (("NeuralNetwork", "Training"), "reshuffle", "sample"),
 )
 
 # Log-name encoding: "<tag><value>" segments in this order, then the two

@@ -46,3 +46,28 @@ def pytest_pna_multihead_converges_under_pallas(monkeypatch):
             f"head {ihead}: RMSE {float(rmse):.4f} exceeds gate "
             f"{THRESHOLDS['PNA'][0]} x {SCATTER_ALLOWANCE} under the fused kernel"
         )
+
+
+@pytest.mark.mpi_skip
+def pytest_pna_multihead_converges_under_sorted(monkeypatch):
+    """Same flagship cell under the scatter-free sorted path — the TPU
+    production DEFAULT since the r05 hardware race (BENCH_r05_sorted.json:
+    926k graphs/s/chip vs the 812k XLA pin; CERTIFY_r05.json sorted arm
+    certified fwd 3.0e-5 / grad 1.5e-4 on chip). CPU keeps the XLA default,
+    so this arm is exercised explicitly here with the same scatter-allowance
+    contract as the Pallas arm."""
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "1")
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+    os.environ["SERIALIZED_DATA_PATH"] = os.getcwd()
+    config = load_ci_config("ci_multihead.json", "PNA")
+    ensure_raw_datasets(config)
+
+    hydragnn_tpu.run_training(config)
+    _, rmse_task, _, _ = hydragnn_tpu.run_prediction(config)
+
+    gate = THRESHOLDS["PNA"][0] * SCATTER_ALLOWANCE
+    for ihead, rmse in enumerate(np.atleast_1d(np.asarray(rmse_task))):
+        assert float(rmse) < gate, (
+            f"head {ihead}: RMSE {float(rmse):.4f} exceeds gate "
+            f"{THRESHOLDS['PNA'][0]} x {SCATTER_ALLOWANCE} under the sorted path"
+        )

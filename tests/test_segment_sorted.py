@@ -203,3 +203,71 @@ def pytest_sorted_training_step_converges(monkeypatch):
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.3, losses[:3] + losses[-3:]
+
+
+def pytest_sorted_default_follows_execution_platform(monkeypatch):
+    """The sorted path defaults ON exactly for TPU execution (r05 hardware
+    race winner) and OFF elsewhere; HYDRAGNN_SEGMENT_SORTED overrides both
+    ways. The platform comes from ops.segment.execution_platform — the same
+    trace-time pin (trainer's pallas_platform) the Pallas gate uses, so a
+    TPU-attached host tracing a CPU mesh keeps the CPU default."""
+    from hydragnn_tpu.ops import segment as seg
+    from hydragnn_tpu.ops import segment_sorted as srt
+
+    monkeypatch.delenv("HYDRAGNN_SEGMENT_SORTED", raising=False)
+    with seg.platform_override("tpu"):
+        assert srt.sorted_enabled()
+    with seg.platform_override("cpu"):
+        assert not srt.sorted_enabled()
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "0")
+    with seg.platform_override("tpu"):
+        assert not srt.sorted_enabled()
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "1")
+    with seg.platform_override("cpu"):
+        assert srt.sorted_enabled()
+
+
+def pytest_sorted_path_under_graph_shard_map(monkeypatch):
+    """Edge-sharded (graph-parallel) aggregation through the sorted path —
+    the composition the TPU-default flip makes production for distributed
+    runs. A contiguous slice of a globally sorted edge array is still
+    non-decreasing, so each shard satisfies the sorted contract; partial
+    sums compose via psum. Values (not just finiteness) must match the
+    single-device sorted result, and gradients must flow."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "1")
+    monkeypatch.setenv("HYDRAGNN_PALLAS", "0")
+    rng = np.random.default_rng(11)
+    e, n, f = 64, 10, 5
+    data = jnp.asarray(rng.normal(size=(e, f)).astype(np.float32))
+    ids = jnp.asarray(np.sort(rng.integers(0, n, size=e)).astype(np.int32))
+
+    ref = ps.fused_segment_stats(data, ids, n, sorted_ids=True)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("graph",))
+
+    def local(d_, ids_):
+        total, mean, std, count = ps.fused_segment_stats(
+            d_, ids_, n, axis_name="graph", sorted_ids=True
+        )
+        return total, mean, std, count
+
+    sharded = shard_map(
+        local, mesh=mesh, in_specs=(P("graph"), P("graph")),
+        out_specs=(P(), P(), P(), P()), check_rep=False,
+    )
+    out = sharded(data, ids)
+    for a, b in zip(ref, out):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+    def loss(d_):
+        total, mean, std, _ = sharded(d_, ids)
+        return jnp.sum(total * 0.3 + mean * 1.7 - std * 0.9)
+
+    g = jax.grad(loss)(data)
+    assert bool(jnp.all(jnp.isfinite(g)))
