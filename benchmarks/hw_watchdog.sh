@@ -100,7 +100,12 @@ tune() {
 }
 
 profile_axon() {
-  timeout 2400 python benchmarks/profile_epoch.py --platform axon --out PROFILE_r05.json
+  # --epochs 2: the measurement is dominated by serial remote compiles
+  # through the tunnel (the 2400s/4-epoch variant hit its timeout with no
+  # artifact); two steady epochs already separate feed from step at the
+  # ~0.5 s epoch times involved.
+  timeout 3600 python benchmarks/profile_epoch.py --platform axon --epochs 2 \
+    --out PROFILE_r05.json
 }
 
 matrix_tpu() {
