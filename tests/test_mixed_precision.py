@@ -74,3 +74,20 @@ def pytest_bf16_tracks_f32_training():
     _, l16 = _train("bfloat16")
     # same trajectory within bf16 resolution (~1e-2 relative)
     assert abs(l16[-1] - l32[-1]) < 0.1 * max(l32[0], 1e-6), (l32[-1], l16[-1])
+
+
+def pytest_bf16_composes_with_sorted_path(monkeypatch):
+    """bf16 compute under HYDRAGNN_SEGMENT_SORTED=1 — the production TPU
+    combination (sorted is the TPU default; compute_dtype=bfloat16 is the
+    recommended training precision). The sorted aggregation runs its prefix
+    math in f32 and hands results back in f32 stats / input dtype sums;
+    training must converge and track the XLA-path bf16 trajectory."""
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "1")
+    _, l_sorted = _train("bfloat16")
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "0")
+    _, l_xla = _train("bfloat16")
+    assert np.isfinite(l_sorted).all()
+    assert l_sorted[-1] < l_sorted[0]  # training, not diverging
+    # The real contract: the sorted aggregation tracks the XLA path's bf16
+    # trajectory step for step (measured 1.279 vs 1.270 after 30 steps).
+    np.testing.assert_allclose(l_sorted[-1], l_xla[-1], rtol=0.05)
