@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..graphs.batch import GraphBatch
@@ -170,6 +171,18 @@ class TrainingDriver:
         # donated by the compiled steps, so reuse is safe.
         self._scan_cache: dict = {}
         self._eval_cache: dict = {}
+        # Permuted replay of a cached chunk, compiled: the within-chunk order
+        # shuffle rides INSIDE the jit (one dispatch, fused gather) instead
+        # of eager per-leaf gathers. State is donated like epoch_scan; the
+        # cached payload must NOT be (it is reused every epoch).
+        self._perm_scan = None
+        if mesh is None:
+            self._perm_scan = jax.jit(
+                lambda s, p, perm, rng: self.epoch_scan(
+                    s, jax.tree_util.tree_map(lambda x: x[perm], p), rng
+                ),
+                donate_argnums=(0,),
+            )
 
     @staticmethod
     def _cache_budget_bytes() -> int:
@@ -275,17 +288,16 @@ class TrainingDriver:
                 if single:
                     self.state, m = self.train_step(self.state, payload, self.rng)
                 else:
-                    # Batch-level order reshuffle WITHIN the chunk too — a
-                    # device-side gather over the stacked axis, so the mode's
-                    # "order reshuffles per epoch" promise holds even when
-                    # the whole epoch fits one chunk. Membership and
-                    # batch->chunk assignment stay frozen (that's the cache).
+                    # Batch-level order reshuffle WITHIN the chunk too —
+                    # compiled into the scan dispatch (see _perm_scan), so
+                    # the mode's "order reshuffles per epoch" promise holds
+                    # even when the whole epoch fits one chunk. Membership
+                    # and batch->chunk assignment stay frozen (the cache).
                     steps = jax.tree_util.tree_leaves(payload)[0].shape[0]
-                    perm = rng.permutation(steps)
-                    shuffled = jax.tree_util.tree_map(
-                        lambda x: x[perm], payload
+                    perm = jnp.asarray(rng.permutation(steps))
+                    self.state, m = self._perm_scan(
+                        self.state, payload, perm, self.rng
                     )
-                    self.state, m = self.epoch_scan(self.state, shuffled, self.rng)
                 metrics.update(m)
             return metrics.averages()
 
