@@ -90,6 +90,13 @@ def pytest_resume_2proc():
     assert len(history1["total_loss_train"]) == 4
     assert load_checkpoint_meta(log_name)["epoch"] == 4
 
+    # EVERY rank must finish reading the phase-1 checkpoint before rank 0
+    # rewinds it below — without this barrier, a rank running behind (load-
+    # dependent scheduling) reads the already-installed epoch-2 state at the
+    # assert above and fails with `assert 2 == 4` (observed under a loaded
+    # host in r05).
+    barrier("resume2proc_post_phase1_asserts")
+
     # Install the mid-run state (or fall back to a meta rewind), rank 0 only.
     if world_rank == 0:
         stop.set()
