@@ -199,3 +199,58 @@ def pytest_config_completion_defaults_reshuffle():
 
     assert ((("NeuralNetwork", "Training"), "reshuffle", "sample")
             in config_utils._DEFAULTS)
+
+
+def pytest_batch_mode_composes_with_resume(tmp_path, monkeypatch):
+    """Training.resume under reshuffle="batch": the device/scan caches are
+    driver-instance state, so a resumed run (fresh driver) must rebuild them
+    and finish with the full history — the production combination of the two
+    round-5 extensions (crash resume + device-resident batching)."""
+    import json
+    import os
+
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.utils.model import load_checkpoint_meta, save_model
+    from tests.deterministic_graph_data import deterministic_graph_data
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("SERIALIZED_DATA_PATH", str(tmp_path))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["Visualization"] = {"create_plots": False}
+    tr = config["NeuralNetwork"]["Training"]
+    tr["num_epoch"] = 4
+    tr["periodic_checkpoint_every"] = 2
+    tr["resume"] = 1
+    tr["reshuffle"] = "batch"
+    for split, cnt in {"train": 48, "test": 16, "validate": 16}.items():
+        p = f"dataset/unit_test_singlehead_{split}"
+        os.makedirs(p, exist_ok=True)
+        deterministic_graph_data(p, number_configurations=cnt)
+        config["Dataset"]["path"][split] = p
+
+    history1 = run_training(dict(config))
+    assert len(history1["total_loss_train"]) == 4
+
+    # Rewind the finished checkpoint's meta to epoch 2 (the crash-resume
+    # install pattern from tests/test_resume_2proc.py) and resume.
+    import pickle
+
+    log = [d for d in os.listdir("logs") if os.path.exists(f"logs/{d}/{d}.pk")][0]
+    ckpt = f"logs/{log}/{log}.pk"
+    with open(ckpt, "rb") as f:
+        payload = pickle.load(f)
+    payload["meta"]["epoch"] = 2
+    payload["meta"]["history"] = {
+        k: v[:2] for k, v in payload["meta"]["history"].items()
+    }
+    with open(ckpt, "wb") as f:
+        pickle.dump(payload, f)
+
+    history2 = run_training(dict(config))
+    assert len(history2["total_loss_train"]) == 4
+    assert load_checkpoint_meta(log)["epoch"] == 4
+    np.testing.assert_allclose(
+        history2["total_loss_train"][:2], history1["total_loss_train"][:2]
+    )
