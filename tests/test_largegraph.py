@@ -85,7 +85,12 @@ def _predict(mesh):
 
 
 @pytest.mark.mpi_skip
-def pytest_largegraph_graph_axis_equivalence(tmp_path):
+@pytest.mark.parametrize("agg_arm", ["xla", "sorted"])
+def pytest_largegraph_graph_axis_equivalence(tmp_path, monkeypatch, agg_arm):
+    # "sorted" = the TPU production default since r05 (graph-sharded edges of
+    # a sorted batch stay sorted per shard); exercised explicitly on the CPU
+    # suite where the platform default is the XLA scatter bundle.
+    monkeypatch.setenv("HYDRAGNN_SEGMENT_SORTED", "1" if agg_arm == "sorted" else "0")
     import jax
 
     if len(jax.devices()) < 4:
