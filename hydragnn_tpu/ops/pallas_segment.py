@@ -93,10 +93,15 @@ def pallas_enabled() -> bool:
     truncate f32 operands to bf16 on hardware only, so the std's
     single-pass sum-of-squares carried ~8e-3 error (16x the gate) and the
     un-rounded lo residual lost its low bits — see _stats_forward_pallas
-    and _sum_count_pallas. Interpreter certification now reproduces
-    hardware numerics (all operands bf16-representable), but the default
-    stays the XLA path until certify_pallas passes ON HARDWARE with
-    speedup > 1 (tests/test_pallas_tpu.py is the canary)."""
+    and _sum_count_pallas. Post-fix the kernel certifies ok=true ON
+    HARDWARE at every block size (CERTIFY_r05.json, TUNE_KERNEL_r05.jsonl)
+    with interpreter certification now hardware-faithful. It nevertheless
+    STAYS opt-in: the end-to-end three-way race (BENCH_r05_*.json) was won
+    by the scatter-free sorted path (ops/segment_sorted.py, the TPU
+    default), with the kernel at ~parity with the XLA bundle. The kernel
+    remains the candidate for workloads the sorted contract cannot cover
+    (unsorted ids at scale); tests/test_pallas_tpu.py stays the hardware
+    canary."""
     env = os.environ.get("HYDRAGNN_PALLAS")
     if env is not None:
         return env not in ("0", "false", "False")
