@@ -298,6 +298,11 @@ def _production_workload():
 
     num_epoch = training["num_epoch"]
     compile_s = steady_s = 0.0
+    # Per-epoch transfer-vs-compute split of the streamed path, accumulated
+    # over the steady epochs from the driver's pipeline stats: H2D bytes +
+    # wire seconds (overlapped with compute on the transfer thread), consumer
+    # queue-wait, and device step seconds.
+    split = {"h2d_bytes": 0, "h2d_s": 0.0, "feed_wait_s": 0.0, "step_s": 0.0}
     for epoch in range(num_epoch):
         bucketed.set_epoch(epoch)
         t0 = time.perf_counter()
@@ -307,6 +312,11 @@ def _production_workload():
             compile_s = dt
         else:
             steady_s += dt
+            fs = driver.feed_stats
+            split["h2d_bytes"] += fs.h2d_bytes
+            split["h2d_s"] += fs.h2d_s
+            split["feed_wait_s"] += fs.feed_wait_s
+            split["step_s"] += fs.step_s
         # Scheduler rides the (untimed) validation pass, like run_training.
         val_loss, _ = driver.evaluate(val_loader)
         lr = get_learning_rate(driver.state.opt_state)
@@ -325,10 +335,23 @@ def _production_workload():
     mae_node = float(np.concatenate(node_abs).mean()) if node_abs else None
 
     n_train = len(bucketed.dataset)
+    steady_epochs = max(num_epoch - 1, 1)
     return {
         "bucketed_throughput": round(n_train * (num_epoch - 1) / steady_s, 2),
         "bucketed_shapes": bucketed.num_buckets,
         "bucketed_compile_s": round(compile_s, 3),
+        # The split below is PER STEADY EPOCH; h2d_s overlaps step_s (the
+        # transfer thread moves batch k+1 during step k), so the two do not
+        # sum to epoch wall time unless the pipeline is transfer-bound —
+        # feed_wait_s is the stall the consumer actually saw.
+        "h2d_mb_per_epoch": round(
+            split["h2d_bytes"] / steady_epochs / (1 << 20), 3
+        ),
+        "h2d_s_per_epoch": round(split["h2d_s"] / steady_epochs, 4),
+        "feed_wait_s_per_epoch": round(
+            split["feed_wait_s"] / steady_epochs, 4
+        ),
+        "step_s_per_epoch": round(split["step_s"] / steady_epochs, 4),
         "mae_node": None if mae_node is None else round(mae_node, 5),
         "rmse_task_max": round(float(max(rmse_task)), 5),
     }
@@ -357,12 +380,63 @@ def _cached_epoch_workload(epochs: int = 8) -> dict:
         else:
             steady_s += dt
     n_train = len(bucketed.dataset)
+    # Steady cached epochs replay device-resident chunks: the h2d split
+    # must read ~0 — reported so the contrast with h2d_s_per_epoch is
+    # visible in the same artifact.
+    fs = driver.feed_stats
     return {
         "bucketed_throughput_cached": round(
             n_train * (epochs - 2) / steady_s, 2
         ),
         "cached_warmup_s": round(first_s, 3),
+        "cached_h2d_s_per_epoch": round(fs.h2d_s, 4),
+        "cached_step_s_per_epoch": round(fs.step_s, 4),
     }
+
+
+def _last_known_hardware(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent hardware measurement from any committed BENCH_* artifact
+    (driver- or watchdog-captured). A dead-tunnel run embeds this block in
+    its failure JSON with ``provenance: "stale"`` so an rc=1 round still
+    carries the last-known-good graphs/sec/chip instead of a bare
+    ``value: 0.0`` (VERDICT r05 item 7)."""
+    import glob
+
+    search_dir = search_dir or os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in glob.glob(os.path.join(search_dir, "BENCH_*.json")):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        # Watchdog wrapper artifacts nest the bench line under "parsed".
+        block = doc.get("parsed", doc)
+        if not isinstance(block, dict):
+            continue
+        if block.get("unit") != "graphs/sec/chip" or not block.get("value"):
+            continue  # failure artifacts carry value 0.0 — not a measurement
+        mtime = os.path.getmtime(path)
+        if best is not None and mtime <= best[0]:
+            continue
+        best = (
+            mtime,
+            {
+                "value": block["value"],
+                "unit": block["unit"],
+                "vs_baseline": block.get("vs_baseline"),
+                "device_kind": block.get("device_kind"),
+                "bucketed_throughput": block.get("bucketed_throughput"),
+                "captured_ts_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
+                ),
+                "source_artifact": os.path.basename(path),
+                "provenance": "stale",
+            },
+        )
+    return best[1] if best else None
 
 
 def _transient(e: Exception) -> bool:
@@ -521,6 +595,14 @@ def main():
         result["error"] = f"{type(e).__name__}: {e}"
         result["trace_tail"] = traceback.format_exc()[-1500:]
         result["retries"] = _RETRIES_USED
+        # Dead rounds still carry the perf signal: the most recent
+        # watchdog/driver hardware block, clearly labeled stale.
+        try:
+            stale = _last_known_hardware()
+            if stale is not None:
+                result["last_known_hardware"] = stale
+        except Exception:
+            pass
         if isinstance(e, TimeoutError):
             # Dead tunnel: corroborate that the benchmark pipeline itself
             # executes by running a REDUCED peak workload on host CPU in a

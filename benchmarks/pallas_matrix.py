@@ -33,6 +33,23 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 FAMILIES = ("SAGE", "GIN", "GAT", "MFC", "PNA", "CGCNN")
 
+# Artifact schema history (PALLAS_MATRIX_r0*.json):
+#   v1 (r04 and earlier): scatter rows carried {"pallas": bool}; top-level had
+#       no "arm"/"env".
+#   v2 (r05+): rows carry {"arm": str} (three aggregation arms, not a binary
+#       kernel toggle) PLUS a "pallas" bool kept for v1-reader continuity;
+#       top-level carries "schema_version", "arm", "env".
+SCHEMA_VERSION = 2
+
+
+def scatter_row_is_pallas(row: dict) -> bool:
+    """Read a scatter row from EITHER schema: v2 {"arm": str} or v1
+    {"pallas": bool}. Tooling comparing rounds should use this instead of
+    poking either key directly."""
+    if "arm" in row:
+        return row["arm"] == "pallas"
+    return bool(row.get("pallas", False))
+
 _CHILD = r"""
 import json, os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -136,7 +153,9 @@ def main():
     thresholds = _thresholds()
     out = {
         "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "schema_version": SCHEMA_VERSION,
         "arm": args.arm,
+        "pallas": args.arm == "pallas",  # v1-reader continuity
         "env": " ".join(f"{k}={v}" for k, v in sorted(_ARMS[args.arm].items())),
         "matrix": [],
     }
@@ -172,7 +191,7 @@ def main():
         for arm in dict.fromkeys(("xla", args.arm)):  # --arm xla: no dup pass
             for seed in range(args.scatter):
                 r = _run_one("PNA", "ci_multihead.json", seed, arm=arm)
-                row = {"arm": arm, "seed": seed}
+                row = {"arm": arm, "pallas": arm == "pallas", "seed": seed}
                 row.update(
                     {"rmse": [round(v, 6) for v in r["rmse"]]}
                     if "rmse" in r

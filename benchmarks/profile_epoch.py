@@ -88,6 +88,10 @@ def main():
         train_loader.set_epoch(e + 1)
         driver.train_epoch(train_loader)
     real_s = (time.perf_counter() - t0) / args.epochs
+    # The driver's pipeline split for the LAST real epoch: H2D bytes/wire
+    # seconds (overlapped, measured on the transfer thread) vs device step
+    # seconds vs consumer queue-wait.
+    feed_split = driver.feed_stats.as_dict()
 
     # Arm 1b: identical batches pre-materialized (zero feed cost). The epoch
     # consumed is the last real epoch's batch sequence, so shapes and chunk
@@ -129,6 +133,8 @@ def main():
         "graphs_per_sec_production": round(n_graphs / real_s, 1),
         "span_feed_wait_s": round(spans.acc.get("feed", 0.0), 4),
         "span_train_dispatch_s": round(spans.acc.get("train_step", 0.0), 4),
+        "span_h2d_s": round(spans.acc.get("h2d", 0.0), 4),
+        "pipeline_split_last_epoch": feed_split,
         "trace_dir": trace_dir,
     }
 

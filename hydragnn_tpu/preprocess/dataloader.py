@@ -70,6 +70,12 @@ class GraphDataLoader:
         self.edge_dim = edge_dim
         self.reshuffle = reshuffle
         self.epoch = 0
+        # Head-spec generation: bumped by set_head_spec so EXTERNAL caches of
+        # collated/device batches (TrainingDriver._scan_cache/_eval_cache)
+        # can detect staleness — the loader's own _batch_cache is cleared
+        # directly, and this counter keeps the two invalidation contracts
+        # symmetric.
+        self.generation = 0
         self._arena = None
         self._frozen_plan = None  # reshuffle="batch": membership drawn once
         self._batch_cache: dict = {}  # plan position -> collated GraphBatch
@@ -129,6 +135,7 @@ class GraphDataLoader:
         self.head_dims = tuple(head_dims)
         self._batch_cache.clear()  # cached collations baked the old spec
         self._cache_bytes = 0
+        self.generation += 1  # external (driver) caches key on this
 
     @property
     def pad_sizes(self):

@@ -1,0 +1,224 @@
+"""Async input pipeline: host collation and host->device transfer overlapped
+with device compute (docs/INPUT_PIPELINE.md).
+
+Round-5 hardware benches put the streamed production path at 770-808 graphs/s
+against 926k graphs/s/chip on the pre-staged scan path (BENCH_r05_hw.json):
+host->device transfer serialized with compute because the single prefetch
+thread overlapped host collation only. The fix is the standard double-buffered
+device feed (tf.data / flax.jax_utils.prefetch_to_device pattern):
+
+    loader.__iter__            _Prefetcher             _Prefetcher
+    (collation, thread 1) --> [host queue] --> transfer (device_put +
+                                               block_until_ready, thread 2)
+                                          --> [device queue, depth 2] --> step
+
+While step *k* executes on device, batch *k+1* is already committed device
+memory and batch *k+2* is in flight on the DMA engine — the steady-state step
+never waits on H2D. The device queue depth of 2 is the double buffer: it
+bounds in-flight HBM to (depth + one being transferred) batches.
+
+Blocking on the transfer INSIDE the transfer thread is deliberate: transfers
+land on the DMA engine, so the wait does not stall compute, it gives the
+pipeline backpressure, and it makes the recorded H2D seconds the true wire
+time rather than the (async) dispatch time. Those seconds land in
+``FeedStats`` — the per-epoch transfer-vs-compute split surfaced through
+``Timer``/``Profiler`` and reported by bench.py next to the throughput.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+
+class _Prefetcher:
+    """Background-thread batch producer: the stage boundary of the pipeline.
+    Bounded queue; exceptions re-raised at the consumer; abandoning iteration
+    (e.g. the train step raising) cancels the producer so neither the thread
+    nor queued batches leak."""
+
+    _SENTINEL = object()
+
+    def __init__(self, iterable: Iterable, depth: int = 8):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err = None
+        self._cancel = threading.Event()
+
+        def _run():
+            try:
+                for item in iterable:
+                    while not self._cancel.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._cancel.is_set():
+                        return
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                # The sentinel must not be dropped: with the queue full (>=
+                # depth batches and a momentarily slow consumer) put_nowait
+                # would raise Full, the consumer would drain the items and
+                # then block on get() forever. Block with cancel checks,
+                # exactly like regular items.
+                while not self._cancel.is_set():
+                    try:
+                        self._q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(
+            target=_run, name="hydragnn-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._cancel.set()
+        # Drain so a producer blocked on put() wakes and exits.
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        # Wake a CONSUMER blocked on get(): when stages are chained, the
+        # downstream stage's thread sits in this queue's get() — draining
+        # alone could swallow the sentinel and leave it blocked forever.
+        try:
+            self._q.put_nowait(self._SENTINEL)
+        except Exception:
+            pass
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._SENTINEL:
+                    if self._err is not None:
+                        raise self._err
+                    return
+                yield item
+        finally:
+            self.close()
+
+
+class FeedStats:
+    """Per-epoch transfer-vs-compute split of one epoch-level driver call.
+
+    Written from two threads without a lock, by design: the transfer thread
+    owns the ``h2d_*`` fields, the consumer owns ``feed_wait_s``/``step_s``
+    (disjoint fields, and the consumer only reads the totals after the
+    pipeline drained).
+
+    - ``h2d_bytes`` / ``h2d_s``: payload bytes moved host->device and the
+      true wire seconds (measured around a blocking device_put in the
+      transfer thread — overlapped with compute, so this is NOT a share of
+      epoch wall time unless the pipeline is transfer-bound).
+    - ``feed_wait_s``: consumer seconds blocked on the device queue — where
+      an input-bound pipeline actually stalls.
+    - ``step_s``: consumer seconds in step dispatch + metrics readback (the
+      readback blocks on the device computation, so this is compute-bound
+      wall time).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.h2d_bytes = 0
+        self.h2d_s = 0.0
+        self.h2d_transfers = 0
+        self.feed_wait_s = 0.0
+        self.step_s = 0.0
+
+    def record_h2d(self, nbytes: int, seconds: float):
+        self.h2d_bytes += int(nbytes)
+        self.h2d_s += seconds
+        self.h2d_transfers += 1
+
+    def as_dict(self) -> dict:
+        return {
+            "h2d_bytes": self.h2d_bytes,
+            "h2d_s": round(self.h2d_s, 4),
+            "h2d_transfers": self.h2d_transfers,
+            "feed_wait_s": round(self.feed_wait_s, 4),
+            "step_s": round(self.step_s, 4),
+        }
+
+
+class DeviceFeed:
+    """Two-stage bounded pipeline: a host stage runs ``iterable`` (collation)
+    in one thread; a transfer stage applies ``transfer`` (device_put dispatch
+    + completion wait) in a second thread; the consumer iterates committed
+    device arrays. With ``transfer=None`` this degrades to the single-stage
+    host prefetcher (the pre-round-6 behavior).
+
+    Exceptions raised in either stage re-raise at the consumer; ``close()``
+    (also triggered by abandoning iteration) cancels both threads, in
+    downstream-first order so a transfer thread blocked on the host queue is
+    woken by the host stage's close."""
+
+    def __init__(
+        self,
+        iterable: Iterable,
+        transfer: Optional[Callable] = None,
+        host_depth: int = 8,
+        device_depth: int = 2,
+    ):
+        self._host = _Prefetcher(iterable, depth=host_depth)
+        self._dev = (
+            None
+            if transfer is None
+            else _Prefetcher(map(transfer, self._host), depth=device_depth)
+        )
+
+    def close(self):
+        if self._dev is not None:
+            self._dev.close()
+        self._host.close()
+
+    def join(self, timeout: float = 5.0) -> bool:
+        """True when both stage threads have exited (tests/diagnostics)."""
+        self._host._thread.join(timeout)
+        if self._dev is not None:
+            self._dev._thread.join(timeout)
+        return not (
+            self._host._thread.is_alive()
+            or (self._dev is not None and self._dev._thread.is_alive())
+        )
+
+    def __iter__(self):
+        src = self._dev if self._dev is not None else self._host
+        try:
+            yield from src
+        finally:
+            self.close()
+
+
+class timed_consume:
+    """Context manager crediting a wall-time region to a FeedStats field.
+    Plain class (not contextlib.contextmanager): it sits twice in the
+    per-batch consumer hot loop, so one small allocation per use."""
+
+    __slots__ = ("_stats", "_field", "_t0")
+
+    def __init__(self, stats: FeedStats, field: str):
+        self._stats = stats
+        self._field = field
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        setattr(
+            self._stats,
+            self._field,
+            getattr(self._stats, self._field)
+            + time.perf_counter()
+            - self._t0,
+        )

@@ -9,6 +9,8 @@ and the TensorBoard writer actually works (model.py:50-54 quirk)."""
 
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import List, Optional
 
 import jax
@@ -21,8 +23,10 @@ from ..utils.optimizer import ReduceLROnPlateau, get_learning_rate, set_learning
 from ..utils.print_utils import iterate_tqdm, print_distributed
 from ..utils.profile import Profiler
 from ..utils.time_utils import Timer
+from .pipeline import DeviceFeed, FeedStats, _Prefetcher, timed_consume  # noqa: F401  (_Prefetcher re-exported for compat)
 from .trainer import (
     TrainState,
+    _batch_pspec,
     make_eval_step,
     make_eval_step_dp,
     make_train_epoch_scan,
@@ -31,76 +35,6 @@ from .trainer import (
     stack_batches,
     state_donation_safe,
 )
-
-
-class _Prefetcher:
-    """Background-thread batch producer: host-side collation (numpy packing in
-    GraphDataLoader.__iter__) overlaps with device compute instead of
-    serializing with it. Bounded queue; exceptions re-raised at the consumer;
-    abandoning iteration (e.g. the train step raising) cancels the producer so
-    neither the thread nor queued batches leak."""
-
-    _SENTINEL = object()
-
-    def __init__(self, iterable, depth: int = 8):
-        import queue
-        import threading
-
-        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
-        self._err = None
-        self._cancel = threading.Event()
-
-        def _run():
-            try:
-                for item in iterable:
-                    while not self._cancel.is_set():
-                        try:
-                            self._q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if self._cancel.is_set():
-                        return
-            except BaseException as e:  # propagate to consumer
-                self._err = e
-            finally:
-                # The sentinel must not be dropped: with the queue full (>=
-                # depth batches and a momentarily slow consumer) put_nowait
-                # would raise Full, the consumer would drain the items and
-                # then block on get() forever. Block with cancel checks,
-                # exactly like regular items.
-                while not self._cancel.is_set():
-                    try:
-                        self._q.put(self._SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-
-        self._thread = threading.Thread(
-            target=_run, name="hydragnn-prefetch", daemon=True
-        )
-        self._thread.start()
-
-    def close(self):
-        self._cancel.set()
-        # Drain so a producer blocked on put() wakes and exits.
-        try:
-            while True:
-                self._q.get_nowait()
-        except Exception:
-            pass
-
-    def __iter__(self):
-        try:
-            while True:
-                item = self._q.get()
-                if item is self._SENTINEL:
-                    if self._err is not None:
-                        raise self._err
-                    return
-                yield item
-        finally:
-            self.close()
 
 
 class EpochMetrics:
@@ -183,6 +117,89 @@ class TrainingDriver:
                 ),
                 donate_argnums=(0,),
             )
+        # Whether the 'graph' mesh axis is active (edge arrays then need the
+        # P('data','graph') placement the sharded step expects).
+        self._graph_sharded = (
+            mesh is not None
+            and model.graph_axis is not None
+            and mesh.shape.get("graph", 1) > 1
+        )
+        # Per-epoch transfer-vs-compute split of the LAST epoch-level call
+        # (train_epoch / evaluate): filled by the device-feed pipeline,
+        # credited into the Timer registry, read by bench.py.
+        self.feed_stats = FeedStats()
+        self._sharding_trees: dict = {}  # batch structure -> NamedSharding tree
+
+    # ----------------------------------------------------------- device feed
+    def _sharding_tree(self, batch):
+        """NamedSharding tree matching the placement the sharded step expects
+        (the same _batch_pspec its shard_map uses), so the pipeline's
+        device_put commits arrays exactly where the step reads them.
+        Shardings are shape-agnostic, so the tree is memoized per batch
+        STRUCTURE (edge presence, head count, static pad) — the transfer
+        thread must not rebuild ~10 NamedShardings per batch."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        key = (
+            batch.edge_features is None,
+            len(batch.targets),
+            batch.num_graphs_pad,
+        )
+        cached = self._sharding_trees.get(key)
+        if cached is None:
+            spec = _batch_pspec(batch, self._graph_sharded)
+            cached = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                spec,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+            self._sharding_trees[key] = cached
+        return cached
+
+    def _put_timed(self, payload, prof=None):
+        """The transfer stage: ONE blocking device_put per payload, on the
+        pipeline's transfer thread. Batch k+1 commits (DMA) while step k
+        computes; blocking here records true wire seconds, not dispatch."""
+        span = (
+            prof.annotate("h2d") if prof is not None else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        with span:
+            if self.multihost:
+                dev = self._lift(payload)
+            elif self.mesh is not None:
+                dev = jax.device_put(payload, self._sharding_tree(payload))
+            else:
+                dev = jax.device_put(payload)
+            jax.block_until_ready(dev)
+        self.feed_stats.record_h2d(
+            self._tree_nbytes(payload), time.perf_counter() - t0
+        )
+        return dev
+
+    def _put_chunk(self, item):
+        single, payload = item
+        return single, self._put_timed(payload)
+
+    def _drain_feed(self, feed, label: str):
+        """End-of-epoch teardown: cancel the pipeline and give its threads a
+        bounded window to exit BEFORE the stats are credited/reset — an
+        in-flight transfer completing later must not record H2D into the
+        next epoch's split (the join is bounded so a transfer wedged on a
+        dead device link cannot hang the caller)."""
+        feed.close()
+        feed.join(2.0)
+        self._credit_timers(label)
+
+    def _credit_timers(self, label: str):
+        """Fold the epoch's split into the Timer registry (print_timers)."""
+        s = self.feed_stats
+        if s.h2d_transfers:
+            Timer.credit(f"{label}_h2d_transfer", s.h2d_s)
+        if s.step_s:
+            Timer.credit(f"{label}_device_step", s.step_s)
+        if s.feed_wait_s:
+            Timer.credit(f"{label}_feed_wait", s.feed_wait_s)
 
     @staticmethod
     def _cache_budget_bytes() -> int:
@@ -217,8 +234,9 @@ class TrainingDriver:
             group = groups.setdefault(key, [])
             group.append(b)
             if len(group) == self.n_devices:
-                # Host-side numpy only — the consumer lifts to device arrays
-                # one group at a time, so the prefetch queue never pins HBM.
+                # Host-side numpy only — the TRANSFER stage lifts to device
+                # arrays one group at a time (bounded device queue), so the
+                # host prefetch queue never pins HBM.
                 yield stack_batches(group, self.n_devices)
                 groups[key] = []
         for group in groups.values():
@@ -237,30 +255,41 @@ class TrainingDriver:
         )
 
     def train_epoch(self, loader, profiler: Optional[Profiler] = None):
+        self.feed_stats.reset()
         # Scan path only when nothing needs per-step host hooks.
         if self.mesh is None and not (profiler and profiler.active):
             return self._train_epoch_scan(loader)
         metrics = EpochMetrics()
-        batches = _Prefetcher(
-            self._device_groups(loader) if self.mesh is not None else iter(loader)
-        )
         prof = profiler or Profiler()
+        # Two-stage device feed: collation thread -> transfer thread
+        # (device_put with the step's placement) -> this consumer. Batch k+1
+        # is committed device memory while step k executes.
+        batches = DeviceFeed(
+            self._device_groups(loader) if self.mesh is not None else iter(loader),
+            transfer=lambda b: self._put_timed(b, prof),
+        )
         batch_iter = iter(iterate_tqdm(batches, self.verbosity))
-        while True:
-            # "feed" covers batch ACQUISITION (the prefetcher queue wait —
-            # where an input-bound pipeline actually stalls) plus the
-            # multi-host lift, not just the lift.
-            with prof.annotate("feed"):
-                batch = next(batch_iter, None)
+        try:
+            while True:
+                # "feed" covers batch ACQUISITION (the device-queue wait —
+                # where an input-bound pipeline actually stalls); collation,
+                # the multi-host lift, and the H2D transfer all already
+                # happened on the pipeline threads.
+                with prof.annotate("feed"), timed_consume(
+                    self.feed_stats, "feed_wait_s"
+                ):
+                    batch = next(batch_iter, None)
                 if batch is None:
                     break
-                if self.mesh is not None:
-                    batch = self._lift(batch)
-            with prof.annotate("train_step"):
-                self.state, m = self.train_step(self.state, batch, self.rng)
-                metrics.update(m)
-            if profiler:
-                profiler.step()
+                with prof.annotate("train_step"), timed_consume(
+                    self.feed_stats, "step_s"
+                ):
+                    self.state, m = self.train_step(self.state, batch, self.rng)
+                    metrics.update(m)
+                if profiler:
+                    profiler.step()
+        finally:
+            self._drain_feed(batches, "train")
         return metrics.averages()
 
     def _train_epoch_scan(self, loader):
@@ -276,8 +305,14 @@ class TrainingDriver:
         the dominant cost when the device link is a tunnel. Batch visit
         order still reshuffles per epoch (chunk dispatch order on host, plus
         a device-side permutation of each chunk's stacked axis). Capped by
-        HYDRAGNN_DEVICE_CACHE_MB (default 512)."""
+        HYDRAGNN_DEVICE_CACHE_MB (default 512). Cache entries carry the
+        loader's head-spec generation; a set_head_spec after the build makes
+        the entry a miss (the device batches baked the old targets)."""
+        gen = getattr(loader, "generation", None)
         cached = self._scan_cache.get(id(loader))
+        if cached is not None and cached.get("generation") != gen:
+            del self._scan_cache[id(loader)]
+            cached = None
         if cached is not None and cached.get("chunks") is not None:
             metrics = EpochMetrics()
             rng = np.random.default_rng(
@@ -285,39 +320,53 @@ class TrainingDriver:
             )
             for ci in rng.permutation(len(cached["chunks"])):
                 single, payload = cached["chunks"][ci]
-                if single:
-                    self.state, m = self.train_step(self.state, payload, self.rng)
-                else:
-                    # Batch-level order reshuffle WITHIN the chunk too —
-                    # compiled into the scan dispatch (see _perm_scan), so
-                    # the mode's "order reshuffles per epoch" promise holds
-                    # even when the whole epoch fits one chunk. Membership
-                    # and batch->chunk assignment stay frozen (the cache).
-                    steps = jax.tree_util.tree_leaves(payload)[0].shape[0]
-                    perm = jnp.asarray(rng.permutation(steps))
-                    self.state, m = self._perm_scan(
-                        self.state, payload, perm, self.rng
-                    )
-                metrics.update(m)
+                with timed_consume(self.feed_stats, "step_s"):
+                    if single:
+                        self.state, m = self.train_step(
+                            self.state, payload, self.rng
+                        )
+                    else:
+                        # Batch-level order reshuffle WITHIN the chunk too —
+                        # compiled into the scan dispatch (see _perm_scan), so
+                        # the mode's "order reshuffles per epoch" promise holds
+                        # even when the whole epoch fits one chunk. Membership
+                        # and batch->chunk assignment stay frozen (the cache).
+                        steps = jax.tree_util.tree_leaves(payload)[0].shape[0]
+                        perm = jnp.asarray(rng.permutation(steps))
+                        self.state, m = self._perm_scan(
+                            self.state, payload, perm, self.rng
+                        )
+                    metrics.update(m)
+            self._credit_timers("train")
             return metrics.averages()
 
         cacheable = (
             getattr(loader, "reshuffle", None) == "batch"
+            # A fixed-order loader (shuffle=False) must never be replayed
+            # with per-epoch permutations: the cache's replay contract IS
+            # the "membership frozen, order reshuffles" mode.
+            and getattr(loader, "shuffle", False)
             and self.mesh is None
             and id(loader) not in self._scan_cache  # not marked over-budget
         )
         sink: Optional[dict] = {"items": [], "bytes": 0} if cacheable else None
         metrics = EpochMetrics()
-        bufs: dict = {}
-        for b in iterate_tqdm(_Prefetcher(iter(loader)), self.verbosity):
-            buf = bufs.setdefault(self._shape_key(b), [])
-            buf.append(b)
-            if len(buf) == self.scan_chunk:
-                sink = self._run_scan_chunk(buf, metrics, sink)
-                buf.clear()
-        for buf in bufs.values():
-            if buf:
-                sink = self._run_scan_chunk(buf, metrics, sink)
+        # Two-stage device feed over stacked chunks: collation + stacking on
+        # the host thread, device_put on the transfer thread, so chunk k+1
+        # is committed while chunk k's scan executes. device_depth=1 (not
+        # the per-batch default): payloads here are WHOLE scan chunks, and
+        # one queued + one transferring + one computing already bounds the
+        # transient HBM at ~3 chunks while keeping the overlap.
+        feed = DeviceFeed(
+            self._host_chunks(loader),
+            transfer=self._put_chunk,
+            device_depth=1,
+        )
+        try:
+            for single, payload in feed:
+                sink = self._run_scan_chunk(single, payload, metrics, sink)
+        finally:
+            self._drain_feed(feed, "train")
         if cacheable:
             # A None sink means the budget was blown mid-epoch. The loader
             # ref is kept EITHER WAY: the verdict is keyed by id(loader),
@@ -325,27 +374,50 @@ class TrainingDriver:
             # its id to a new loader that would silently inherit it.
             self._scan_cache[id(loader)] = {
                 "loader": loader,
+                "generation": gen,
                 "chunks": sink["items"] if sink is not None else None,
             }
         return metrics.averages()
 
-    def _run_scan_chunk(self, batches, metrics, sink: Optional[dict] = None):
-        """Dispatch one chunk; when ``sink`` is given, also device_put the
-        dispatched payload into it (the reshuffle="batch" device cache),
-        returning None instead once the byte budget is exceeded. ``sink``
-        carries a running byte total so the first (timed) epoch's
-        bookkeeping stays O(1) per chunk."""
+    def _host_chunks(self, loader):
+        """Stage-1 producer for the scan path: collate (loader.__iter__) and
+        group batches by shape into scan-chunk stacks, yielding
+        ``(single, host payload)``. Runs on the pipeline's host thread, so
+        numpy stacking also overlaps device compute."""
+        bufs: dict = {}
+        for b in iterate_tqdm(loader, self.verbosity):
+            buf = bufs.setdefault(self._shape_key(b), [])
+            buf.append(b)
+            if len(buf) == self.scan_chunk:
+                yield self._stack_chunk(buf)
+                buf.clear()
+        for buf in bufs.values():
+            if buf:
+                yield self._stack_chunk(buf)
+
+    @staticmethod
+    def _stack_chunk(batches):
         if len(batches) == 1:
-            payload, single = batches[0], True
-            self.state, m = self.train_step(self.state, payload, self.rng)
-        else:
-            payload, single = stack_batches(batches, len(batches)), False
-            self.state, m = self.epoch_scan(self.state, payload, self.rng)
-        metrics.update(m)
+            return True, batches[0]
+        return False, stack_batches(batches, len(batches))
+
+    def _run_scan_chunk(self, single, payload, metrics, sink: Optional[dict]):
+        """Dispatch one device-resident chunk; when ``sink`` is given, retain
+        THE SAME device copy for the reshuffle="batch" cache — the pipeline
+        already transferred it, so the cache-building epoch performs exactly
+        one host->device transfer per chunk. Returns None instead once the
+        byte budget is exceeded; ``sink`` carries a running byte total so the
+        first (timed) epoch's bookkeeping stays O(1) per chunk."""
+        with timed_consume(self.feed_stats, "step_s"):
+            if single:
+                self.state, m = self.train_step(self.state, payload, self.rng)
+            else:
+                self.state, m = self.epoch_scan(self.state, payload, self.rng)
+            metrics.update(m)
         if sink is not None:
             nbytes = self._tree_nbytes(payload)
             if sink["bytes"] + nbytes <= self._cache_budget_bytes():
-                sink["items"].append((single, jax.device_put(payload)))
+                sink["items"].append((single, payload))
                 sink["bytes"] += nbytes
             else:
                 sink = None
@@ -356,6 +428,7 @@ class TrainingDriver:
         """validate()/test() analog. With return_values, also gathers per-head
         (true, predicted) arrays over real rows (test(), reference
         train_validate_test.py:267-304)."""
+        self.feed_stats.reset()
         prof = profiler or Profiler()
         metrics = EpochMetrics()
         num_heads = len(self.model.output_dim)
@@ -390,14 +463,23 @@ class TrainingDriver:
         # resident after the first evaluate() — the per-epoch validation pass
         # then skips collation and host->device transfer entirely. Host
         # copies ride along for consume()'s masks/targets.
+        gen = getattr(loader, "generation", None)
         cached = self._eval_cache.get(id(loader))
+        if cached is not None and cached.get("generation") != gen:
+            # set_head_spec bumped the loader's generation after this cache
+            # was built: the device batches baked the old head spec/targets.
+            del self._eval_cache[id(loader)]
+            cached = None
         if cached is not None and cached.get("batches") is not None:
             for host_b, dev_b in cached["batches"]:
-                with prof.annotate("eval_step"):
+                with prof.annotate("eval_step"), timed_consume(
+                    self.feed_stats, "step_s"
+                ):
                     m, outputs = self.eval_step(self.state, dev_b)
                     metrics.update(m)
                 if return_values:
                     consume(host_b, outputs)
+            self._credit_timers("eval")
         else:
             cacheable = (
                 self.mesh is None
@@ -405,33 +487,40 @@ class TrainingDriver:
                 and id(loader) not in self._eval_cache
             )
             sink: Optional[dict] = {"items": [], "bytes": 0} if cacheable else None
-            batches = _Prefetcher(
-                self._device_groups(loader) if self.mesh is not None else iter(loader)
+            # Two-stage device feed, pairing each host batch (consume()'s
+            # masks/targets are host-side, like the reference's per-rank
+            # test() lists) with its device copy — which on a mesh is the
+            # same GLOBAL [D_global, ...] lift train_epoch performs. The
+            # cache sink reuses that same device copy: one transfer per
+            # batch, cache build included.
+            batches = DeviceFeed(
+                self._device_groups(loader) if self.mesh is not None else iter(loader),
+                transfer=lambda b: (b, self._put_timed(b, prof)),
             )
-            for batch in batches:
-                # Same multi-host lift as train_epoch: the sharded eval step
-                # wants a GLOBAL [D_global, ...] array; each process only
-                # stacked its local slice. consume() keeps the host-local
-                # batch (its masks and targets are this process's rows, like
-                # the reference's per-rank test() lists).
-                lifted = self._lift(batch) if self.mesh is not None else batch
-                with prof.annotate("eval_step"):
-                    m, outputs = self.eval_step(self.state, lifted)
-                    metrics.update(m)
-                if return_values:
-                    consume(batch, outputs)
-                if sink is not None:
-                    nbytes = self._tree_nbytes(batch)
-                    if sink["bytes"] + nbytes <= self._cache_budget_bytes():
-                        sink["items"].append((batch, jax.device_put(batch)))
-                        sink["bytes"] += nbytes
-                    else:
-                        sink = None
+            try:
+                for batch, dev_b in batches:
+                    with prof.annotate("eval_step"), timed_consume(
+                        self.feed_stats, "step_s"
+                    ):
+                        m, outputs = self.eval_step(self.state, dev_b)
+                        metrics.update(m)
+                    if return_values:
+                        consume(batch, outputs)
+                    if sink is not None:
+                        nbytes = self._tree_nbytes(batch)
+                        if sink["bytes"] + nbytes <= self._cache_budget_bytes():
+                            sink["items"].append((batch, dev_b))
+                            sink["bytes"] += nbytes
+                        else:
+                            sink = None
+            finally:
+                self._drain_feed(batches, "eval")
             if cacheable:
                 # Keep the loader ref even on an over-budget verdict so a
                 # recycled id() cannot inherit it (see _scan_cache).
                 self._eval_cache[id(loader)] = {
                     "loader": loader,
+                    "generation": gen,
                     "batches": sink["items"] if sink is not None else None,
                 }
 

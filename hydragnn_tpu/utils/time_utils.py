@@ -45,6 +45,17 @@ class Timer:
         self.stop()
 
     @classmethod
+    def credit(cls, name: str, seconds: float) -> None:
+        """Credit externally-measured seconds into the registry — for phases
+        timed off the main thread (the input pipeline's transfer thread
+        measures H2D wire time with its own perf_counter pair and cannot
+        hold a start/stop Timer across threads)."""
+        if seconds <= 0:
+            return
+        cls._totals[name] = cls._totals.get(name, 0.0) + seconds
+        cls._counts[name] = cls._counts.get(name, 0) + 1
+
+    @classmethod
     def reset(cls):
         cls._totals.clear()
         cls._counts.clear()

@@ -1,0 +1,85 @@
+"""Bench-artifact plumbing that must work WITHOUT a device: the stale
+last-known-hardware block embedded in dead-tunnel failure JSON (VERDICT r05
+item 7) and the PALLAS_MATRIX schema-continuity helpers (ADVICE r05 low)."""
+
+import json
+import os
+import time
+
+
+def pytest_last_known_hardware_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_hardware
+
+    # Old-style watchdog wrapper artifact (bench line nested under "parsed")
+    # with a real measurement.
+    old = {
+        "rc": 0,
+        "parsed": {
+            "value": 812122.95,
+            "unit": "graphs/sec/chip",
+            "vs_baseline": 1.0,
+            "device_kind": "TPU v5 lite",
+            "bucketed_throughput": 700.0,
+        },
+    }
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(old))
+    # A dead-tunnel failure artifact: value 0.0 must never be "last known".
+    dead = {"value": 0.0, "unit": "graphs/sec/chip", "error": "TimeoutError"}
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(dead))
+    # Newer bare watchdog artifact — should win on recency.
+    new = {
+        "value": 926028.0,
+        "unit": "graphs/sec/chip",
+        "vs_baseline": 1.14,
+        "device_kind": "TPU v5 lite",
+        "bucketed_throughput": 808.0,
+    }
+    newer = tmp_path / "BENCH_r05_sorted.json"
+    newer.write_text(json.dumps(new))
+    now = time.time()
+    os.utime(tmp_path / "BENCH_r02.json", (now - 100, now - 100))
+    os.utime(tmp_path / "BENCH_r05.json", (now - 10, now - 10))
+    os.utime(newer, (now - 50, now - 50))
+
+    blk = _last_known_hardware(str(tmp_path))
+    assert blk is not None
+    assert blk["value"] == 926028.0
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "BENCH_r05_sorted.json"
+    assert blk["bucketed_throughput"] == 808.0
+    assert blk["captured_ts_utc"]  # dated so a reader can judge staleness
+
+
+def pytest_last_known_hardware_none_when_no_measurements(tmp_path):
+    from bench import _last_known_hardware
+
+    (tmp_path / "BENCH_bad.json").write_text("{not json")
+    (tmp_path / "BENCH_zero.json").write_text(
+        json.dumps({"value": 0.0, "unit": "graphs/sec/chip"})
+    )
+    assert _last_known_hardware(str(tmp_path)) is None
+
+
+def pytest_committed_failure_artifact_would_carry_stale_block():
+    """The repo's own committed artifacts contain at least one real hardware
+    measurement, so a dead-tunnel run TODAY embeds a non-zero stale block."""
+    from bench import _last_known_hardware
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_hardware(repo)
+    assert blk is not None and blk["value"] > 0
+    assert blk["provenance"] == "stale"
+
+
+def pytest_pallas_matrix_schema_readable_both_ways():
+    from benchmarks.pallas_matrix import SCHEMA_VERSION, scatter_row_is_pallas
+
+    assert SCHEMA_VERSION >= 2
+    # v1 rows (r04 and earlier): {"pallas": bool}
+    assert scatter_row_is_pallas({"pallas": True, "seed": 0})
+    assert not scatter_row_is_pallas({"pallas": False, "seed": 0})
+    assert not scatter_row_is_pallas({"seed": 0})
+    # v2 rows (r05+): {"arm": str} (+ compat "pallas" bool)
+    assert scatter_row_is_pallas({"arm": "pallas", "pallas": True})
+    assert not scatter_row_is_pallas({"arm": "xla", "pallas": False})
+    assert not scatter_row_is_pallas({"arm": "sorted"})

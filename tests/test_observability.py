@@ -34,6 +34,21 @@ def pytest_timer_accumulates_and_reduces():
     assert reduce_timers() == {}
 
 
+def pytest_timer_credit_external_seconds():
+    """Timer.credit folds seconds measured off the main thread (the input
+    pipeline's H2D transfer thread) into the same registry print_timers
+    reports from."""
+    Timer.reset()
+    Timer.credit("h2d_transfer", 0.25)
+    Timer.credit("h2d_transfer", 0.75)
+    Timer.credit("noop", 0.0)  # zero/negative credits are dropped
+    Timer.credit("noop", -1.0)
+    stats = reduce_timers()
+    assert stats["h2d_transfer"]["max"] == pytest.approx(1.0)
+    assert "noop" not in stats
+    Timer.reset()
+
+
 def pytest_timer_misuse_raises():
     t = Timer("misuse")
     with pytest.raises(RuntimeError):
