@@ -19,4 +19,7 @@ from . import (
 from .run_training import run_training
 from .run_prediction import run_prediction
 
+# Imported after the subpackages above: serve builds on models/train/graphs.
+from . import serve
+
 __version__ = "0.1.0"

@@ -230,9 +230,21 @@ class HydraGNN(nn.Module):
             t == "graph" for t in self.output_type
         ):
             gcfg = self.config_heads["graph"]
+            # shared_layout "framework" (default): ReLU between every pair of
+            # shared Linears. "reference": the reference's exact Sequential
+            # grammar — NO inner ReLU, only the trailing one (Base.py:155-162)
+            # — required for exact forward parity of imported torch
+            # checkpoints with num_sharedlayers > 1 (utils/torch_import.py).
+            layout = gcfg.get("shared_layout", "framework")
+            if layout not in ("framework", "reference"):
+                raise ValueError(
+                    f"output_heads.graph.shared_layout must be 'framework' "
+                    f"or 'reference', got {layout!r}"
+                )
             self.graph_shared = MLP(
                 tuple([gcfg["dim_sharedlayers"]] * gcfg["num_sharedlayers"]),
                 activate_final=True,
+                inner_activation=layout != "reference",
                 name="graph_shared",
             )
 

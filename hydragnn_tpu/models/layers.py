@@ -55,11 +55,20 @@ class MaskedBatchNorm(nn.Module):
 class MLP(nn.Module):
     """Dense stack: Linear(dims[0]) → ReLU → ... → Linear(dims[-1]), optionally with
     a trailing activation and a custom final-bias constant (UQ initial_bias,
-    reference Base._set_bias, Base.py:113-118)."""
+    reference Base._set_bias, Base.py:113-118).
+
+    ``inner_activation=False`` drops the ReLUs BETWEEN Linears (the trailing
+    ``activate_final`` ReLU is unaffected) — the reference's shared-MLP
+    Sequential grammar (Base.py:155-162 builds [ReLU, Linear, Linear, ...,
+    ReLU]: activation only before the first Linear — a no-op on the
+    non-negative pooled encoder output — and after the last). The
+    checkpoint importer needs this layout to reproduce reference forwards
+    exactly for ``num_sharedlayers > 1`` (utils/torch_import.py)."""
 
     dims: Sequence[int]
     activate_final: bool = False
     final_bias_value: float | None = None
+    inner_activation: bool = True
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -73,6 +82,8 @@ class MLP(nn.Module):
                 )(x)
             else:
                 x = nn.Dense(d, name=f"dense_{i}")(x)
-            if (not last) or self.activate_final:
+            if (last and self.activate_final) or (
+                not last and self.inner_activation
+            ):
                 x = nn.relu(x)
         return x

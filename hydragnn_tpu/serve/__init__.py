@@ -1,0 +1,27 @@
+"""Online inference serving: micro-batching engine + stdlib HTTP front end.
+
+    python -m hydragnn_tpu.serve --config logs/<name>/config.json [--ckpt ...]
+
+See docs/SERVING.md for the request schema, bucket-ladder/warmup
+configuration, backpressure semantics, and the metrics reference.
+"""
+
+from .engine import (
+    BackpressureError,
+    EngineClosedError,
+    EngineFailedError,
+    InferenceEngine,
+)
+from .metrics import LatencyHistogram, ServeMetrics
+from .server import InferenceServer, parse_graph
+
+__all__ = [
+    "BackpressureError",
+    "EngineClosedError",
+    "EngineFailedError",
+    "InferenceEngine",
+    "InferenceServer",
+    "LatencyHistogram",
+    "ServeMetrics",
+    "parse_graph",
+]

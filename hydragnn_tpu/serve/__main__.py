@@ -1,0 +1,93 @@
+"""CLI entry: ``python -m hydragnn_tpu.serve --config ... [--ckpt ...]``.
+
+Loads a checkpoint (native or reference-torch), optionally warms the bucket
+ladder, and serves /predict, /healthz, /metrics until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .engine import InferenceEngine
+from .server import InferenceServer
+
+
+def parse_ladder(spec: str):
+    """--bucket-ladder "512x4096,1024x8192" → [(512, 4096), (1024, 8192)]."""
+    ladder = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        n, e = part.split("x")
+        ladder.append((int(n), int(e)))
+    return ladder
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.serve",
+        description="Online inference server for HydraGNN checkpoints.",
+    )
+    ap.add_argument(
+        "--config",
+        required=True,
+        help="COMPLETED config JSON (the logs/<name>/config.json snapshot)",
+    )
+    ap.add_argument(
+        "--ckpt",
+        default=None,
+        help="checkpoint path (native .pk or reference torch .pk); default: "
+        "the config-derived logs/<log_name>/<log_name>.pk",
+    )
+    ap.add_argument(
+        "--ckpt-format",
+        choices=("auto", "native", "torch"),
+        default="auto",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch-graphs", type=int, default=32)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--queue-limit", type=int, default=256)
+    ap.add_argument(
+        "--bucket-ladder",
+        default="",
+        help='comma-separated "NxE" padded shapes, e.g. "512x4096,1024x8192"; '
+        "compiled at startup unless --no-warmup",
+    )
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--verbose", action="store_true")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    ladder = parse_ladder(args.bucket_ladder) if args.bucket_ladder else None
+    engine = InferenceEngine.from_config(
+        args.config,
+        checkpoint=args.ckpt,
+        checkpoint_format=args.ckpt_format,
+        max_batch_graphs=args.max_batch_graphs,
+        max_delay_ms=args.max_delay_ms,
+        queue_limit=args.queue_limit,
+        bucket_ladder=ladder,
+        warmup=not args.no_warmup,
+    )
+    server = InferenceServer(
+        engine, host=args.host, port=args.port, verbose=args.verbose
+    )
+    print(
+        f"hydragnn_tpu.serve listening on http://{server.host}:{server.port} "
+        f"(buckets compiled: {len(engine._executables)})",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,775 @@
+"""Online inference engine: bucketed micro-batching over the padded-arena
+collation contract, with a compiled-executable cache and bounded-queue
+backpressure (docs/SERVING.md).
+
+Why this shape: the repo's only inference surface before this module was the
+offline ``run_prediction`` batch pass. Online traffic needs the same three
+invariants that make the training path fast, re-assembled around a request
+queue:
+
+* **Static shapes.** Requests are collated into the exact padded
+  ``(N_pad, E_pad, G_pad)`` buckets the training collator emits
+  (graphs/collate.py: "XLA compiles once per bucket"), so steady-state
+  traffic reuses a small set of AOT-compiled executables. The cache is
+  explicit (``_executables``) — hits/misses/compile-seconds are serving
+  metrics, and ``warmup()`` pre-compiles a declared bucket ladder so the
+  first user request never pays a compile.
+
+* **Overlap.** Batches flow through the PR-1 two-stage ``DeviceFeed``
+  pipeline (train/pipeline.py): the micro-batcher generator runs on the
+  feed's host thread (queue pop + deadline flush + arena collation), the
+  transfer stage commits each batch with a blocking ``device_put`` on its
+  own thread, and the dispatch thread only ever executes on
+  already-committed device arrays — batch *k+1* transfers while batch *k*
+  computes, exactly like a training epoch.
+
+* **Bounded memory + honest failure.** The request queue is bounded;
+  ``submit`` on a full queue raises :class:`BackpressureError` with a
+  retry-after hint instead of queueing unboundedly (the caller — or the
+  HTTP front end, as 429 — sheds the load). Any exception on the
+  batcher/transfer/dispatch threads fails every pending future and poisons
+  the engine (subsequent submits re-raise the original error): a worker
+  crash is a loud caller-visible failure, never a silently wedged queue.
+
+Numerical contract: the forward is ``_apply_model(model, ..., train=False)``
+— the same function the offline eval step wraps — and padding is inert by
+construction (masked BN/pool/heads, padding edges connect padding nodes), so
+engine outputs are bit-identical to ``run_prediction`` on CPU for the same
+checkpoint and graphs regardless of how requests are grouped into buckets
+(locked by tests/test_serve_engine.py).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graphs.collate import GraphArena, round_up_pow2
+from ..graphs.sample import GraphSample
+from ..train.pipeline import DeviceFeed
+from .metrics import ServeMetrics
+
+
+class BackpressureError(RuntimeError):
+    """Bounded request queue is full — retry after ``retry_after_s``."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class EngineClosedError(RuntimeError):
+    """The engine was shut down (close()) before the request resolved."""
+
+
+class EngineFailedError(RuntimeError):
+    """A worker thread died; the original exception is ``__cause__``."""
+
+
+class _Future:
+    """Minimal thread-safe future.
+
+    Deliberately NOT ``concurrent.futures.Future``: the engine's race
+    closures (submit-vs-close rejection after enqueue, collation-failure
+    rejection racing a normal resolve) rely on a second completion being a
+    benign no-op-overwrite with at-most-one outcome visible to the waiter —
+    the stdlib future raises InvalidStateError there, which inside
+    ``_resolve`` would poison the whole engine. (And on this Python,
+    ``concurrent.futures.TimeoutError`` is not the builtin ``TimeoutError``
+    callers naturally catch.)"""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def set_result(self, value) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference request did not resolve in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclass
+class _Request:
+    sample: GraphSample
+    future: _Future
+    t_submit: float
+
+
+@dataclass
+class _BatchWork:
+    """One flushed micro-batch between the collation and dispatch stages."""
+
+    requests: List[_Request]
+    node_start: np.ndarray  # per-request node offsets into the padded batch
+    batch: Any  # host GraphBatch
+    fallback: bool  # shape came from pow2 fallback, not the ladder
+
+
+_SHUTDOWN = object()
+
+
+class InferenceEngine:
+    """Micro-batching online inference over a HydraGNN model.
+
+    Parameters
+    ----------
+    model, variables:
+        The flax module (``create_model``/``create_model_config``) and its
+        restored variables ({"params", "batch_stats"}).
+    max_batch_graphs:
+        Flush a micro-batch at this many graphs. Also fixes the padded graph
+        dimension: every batch uses ``G_pad = max_batch_graphs + 1`` so the
+        graph axis never contributes extra compiled shapes.
+    max_delay_ms:
+        Flush an open (non-full) batch this many ms after it opened — the
+        bound on latency a lone request pays waiting for batch-mates.
+    queue_limit:
+        Bounded request-queue depth; beyond it ``submit`` raises
+        :class:`BackpressureError`.
+    bucket_ladder:
+        Optional sequence of ``(N_pad, E_pad)`` shapes. A batch takes the
+        smallest ladder entry it fits; only when none fits does it fall back
+        to the pow2 round-up (counted as ``ladder_fallback_total``). With
+        ``warmup=True`` every ladder entry is compiled at construction, so
+        steady-state traffic never recompiles.
+    head_names, y_minmax:
+        Optional per-head names and min-max pairs; with ``y_minmax`` set,
+        outputs are denormalized (``v * (ymax - ymin) + ymin``, the
+        postprocess.output_denormalize arithmetic) before futures resolve.
+    autostart:
+        Tests set False to exercise queue behavior without worker threads;
+        call :meth:`start` to launch them later.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables: Dict[str, Any],
+        *,
+        max_batch_graphs: int = 32,
+        max_delay_ms: float = 5.0,
+        queue_limit: int = 256,
+        bucket_ladder: Optional[Sequence[Tuple[int, int]]] = None,
+        warmup: bool = False,
+        head_names: Optional[Sequence[str]] = None,
+        y_minmax: Optional[Sequence] = None,
+        metrics: Optional[ServeMetrics] = None,
+        autostart: bool = True,
+    ):
+        import jax
+
+        from ..train.trainer import _apply_model
+
+        self.model = model
+        self.max_batch_graphs = int(max_batch_graphs)
+        self.max_delay_ms = float(max_delay_ms)
+        self.queue_limit = int(queue_limit)
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.head_names = (
+            list(head_names)
+            if head_names
+            else [f"head_{i}" for i in range(len(model.output_dim))]
+        )
+        self._y_minmax = y_minmax
+        self._g_pad = self.max_batch_graphs + 1
+        self._edge_dim = model.edge_dim if model.use_edge_attr else 0
+        self._ladder = sorted(
+            (int(n), int(e)) for n, e in (bucket_ladder or ())
+        )
+
+        self._params = jax.device_put(variables["params"])
+        self._bstats = jax.device_put(variables.get("batch_stats", {}))
+        self._jit = jax.jit(
+            lambda params, bstats, batch: _apply_model(
+                model, params, bstats, batch, train=False
+            )
+        )
+        self._executables: Dict[Tuple[int, int, int], Any] = {}
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_limit)
+        self._pending: set = set()
+        self._lock = threading.Lock()
+        self._closing = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._feed: Optional[DeviceFeed] = None
+        self._dispatcher: Optional[threading.Thread] = None
+
+        if warmup and self._ladder:
+            self.warmup()
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        """Launch the batcher→transfer→dispatch pipeline (idempotent)."""
+        if self._dispatcher is not None:
+            return
+        self._feed = DeviceFeed(
+            self._batch_source(), transfer=self._transfer, host_depth=2
+        )
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="hydragnn-serve-dispatch",
+            daemon=True,
+        )
+        self._dispatcher.start()
+
+    @property
+    def running(self) -> bool:
+        return (
+            self._dispatcher is not None
+            and self._dispatcher.is_alive()
+            and self._error is None
+            and not self._closing.is_set()
+        )
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain in-flight batches, stop the threads, fail stragglers."""
+        if self._closing.is_set():
+            return
+        self._closing.set()
+        # The shutdown marker must reach the batcher even under a full
+        # queue: evict (and fail) queued requests until it fits.
+        while True:
+            try:
+                self._queue.put_nowait(_SHUTDOWN)
+                break
+            except queue.Full:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    continue
+                if req is not _SHUTDOWN:
+                    self._reject(req, EngineClosedError("engine closing"))
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout)
+        if self._feed is not None:
+            self._feed.close()
+            self._feed.join(2.0)
+        # Anything still unresolved (e.g. batches dropped by feed teardown).
+        self._fail_pending(EngineClosedError("engine closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- requests
+    def submit(self, sample: GraphSample) -> _Future:
+        """Enqueue one graph; returns a future resolving to the per-head
+        output list ([dim] arrays for graph heads, [n, dim] for node heads).
+        """
+        if self._error is not None:
+            raise EngineFailedError(
+                "inference worker died; engine must be rebuilt"
+            ) from self._error
+        if self._closing.is_set():
+            raise EngineClosedError("engine is shut down")
+        self._validate(sample)
+        req = _Request(sample=sample, future=_Future(), t_submit=time.perf_counter())
+        with self._lock:
+            self._pending.add(req.future)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            with self._lock:
+                self._pending.discard(req.future)
+            self.metrics.count("rejected_total")
+            hint = self._retry_after_hint()
+            raise BackpressureError(
+                f"request queue full ({self.queue_limit}); retry in "
+                f"~{hint:.2f}s",
+                retry_after_s=hint,
+            ) from None
+        # Close the check-then-act race with close()/_fail(): if shutdown or
+        # a worker death landed BETWEEN the checks above and the enqueue, the
+        # batcher may already be past its drain and never pop this request —
+        # fail the future here. (If the batcher does still pop it, the caller
+        # sees the rejection; at-most-one outcome is visible either way.)
+        if self._closing.is_set() or self._error is not None:
+            self._reject(
+                req,
+                EngineClosedError("engine closed during submit")
+                if self._error is None
+                else EngineFailedError("inference worker died"),
+            )
+            return req.future
+        self.metrics.count("requests_total")
+        return req.future
+
+    def predict(
+        self, samples: Sequence[GraphSample], timeout: Optional[float] = 60.0
+    ) -> List[List[np.ndarray]]:
+        """Synchronous convenience: submit all, wait all. Returns one
+        per-head output list per input graph.
+
+        All samples are validated BEFORE any is admitted (a malformed graph
+        rejects the call without consuming device work), and a multi-graph
+        call that cannot fit the queue's free slots is rejected up front —
+        so a 429 for the whole call does not leave a half-admitted batch
+        computing results nobody will read (retry amplification)."""
+        for s in samples:
+            self._validate(s)
+        if len(samples) > self.queue_limit:
+            # Terminal, not transient: no amount of retrying fits this call.
+            raise ValueError(
+                f"predict() of {len(samples)} graphs exceeds queue_limit "
+                f"{self.queue_limit}; split the call or raise the limit"
+            )
+        free = self.queue_limit - self._queue.qsize()
+        if len(samples) > free:
+            self.metrics.count("rejected_total")
+            hint = self._retry_after_hint()
+            raise BackpressureError(
+                f"{len(samples)} graphs exceed the queue's ~{free} free "
+                f"slots; retry in ~{hint:.2f}s",
+                retry_after_s=hint,
+            )
+        futures = []
+        try:
+            for s in samples:
+                futures.append(self.submit(s))
+        except BackpressureError:
+            # Lost the capacity race to concurrent callers: the already-
+            # admitted graphs will compute regardless — drain them so the
+            # engine is quiescent for the caller's retry, then re-raise.
+            for f in futures:
+                try:
+                    f.result(timeout)
+                except Exception:
+                    pass
+            raise
+        return [f.result(timeout) for f in futures]
+
+    def _validate(self, sample: GraphSample) -> None:
+        x = sample.x
+        if x is None or np.ndim(x) != 2:
+            raise ValueError("sample.x must be a [num_nodes, F] array")
+        if x.shape[1] != self.model.input_dim:
+            raise ValueError(
+                f"sample.x feature width {x.shape[1]} != model input_dim "
+                f"{self.model.input_dim}"
+            )
+        if sample.edge_index is not None:
+            ei = np.asarray(sample.edge_index)
+            if ei.ndim != 2 or ei.shape[0] != 2:
+                raise ValueError("sample.edge_index must be [2, num_edges]")
+            # Bounds matter for batch ISOLATION, not just this request: after
+            # the arena's per-graph offset shift an out-of-range index would
+            # alias this graph's edges onto a co-batched graph's nodes.
+            if ei.size and (ei.min() < 0 or ei.max() >= sample.num_nodes):
+                raise ValueError(
+                    "sample.edge_index references nodes outside the graph"
+                )
+        if self._edge_dim and sample.num_edges:
+            # The model consumes per-edge features: a missing attr would
+            # silently zero-fill (wrong predictions with a 200), a wrong
+            # width would blow up collation mid-batch — reject here instead.
+            ea = sample.edge_attr
+            if ea is None:
+                raise ValueError(
+                    f"model expects edge_attr of width {self._edge_dim}; "
+                    "request carries none"
+                )
+            # Row count too: the arena reads attr rows by edge_index counts,
+            # so a mismatch corrupts (or crashes) co-batched requests.
+            if np.ndim(ea) != 2 or np.shape(ea) != (
+                sample.num_edges,
+                self._edge_dim,
+            ):
+                raise ValueError(
+                    f"sample.edge_attr must be [{sample.num_edges}, "
+                    f"{self._edge_dim}], got shape {np.shape(ea)}"
+                )
+        # No size ceiling: a graph too large for every ladder rung is still
+        # serveable through _bucket_shape's pow2 fallback (one compile,
+        # counted as ladder_fallback_total).
+
+    def _retry_after_hint(self) -> float:
+        """Seconds until the queue has likely drained one batch's worth:
+        queued batches x per-batch service estimate (measured device latency
+        when available, else the flush deadline)."""
+        dev = self.metrics.latency["device"]
+        per_batch = (
+            dev.sum / dev.count if dev.count else self.max_delay_ms / 1000.0
+        )
+        batches_queued = max(1, self._queue.qsize() // self.max_batch_graphs)
+        return max(0.05, batches_queued * max(per_batch, 1e-3))
+
+    # ----------------------------------------------------------- the worker
+    def _batch_source(self):
+        """Micro-batcher generator (runs on the DeviceFeed host thread):
+        pop → deadline/size flush → arena collation → host batch."""
+        q = self._queue
+        while True:
+            try:
+                first = q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closing.is_set():
+                    return
+                continue
+            if first is _SHUTDOWN:
+                return
+            entries = [first]
+            saw_shutdown = False
+            deadline = time.perf_counter() + self.max_delay_ms / 1000.0
+            while len(entries) < self.max_batch_graphs:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _SHUTDOWN:
+                    saw_shutdown = True
+                    break
+                entries.append(nxt)
+            try:
+                work = self._collate(entries)
+            except Exception as e:  # noqa: BLE001
+                # A bad batch (collation failure past _validate's checks)
+                # fails ITS requests loudly but must not poison the engine —
+                # batch-mates and later traffic are innocent.
+                for req in entries:
+                    self._reject(req, e)
+                self.metrics.count("errors_total")
+                work = None
+            if work is not None:
+                yield work
+            if saw_shutdown:
+                return
+
+    def _bucket_shape(self, tot_nodes: int, tot_edges: int) -> Tuple[int, int, bool]:
+        """Smallest ladder (N_pad, E_pad) the batch fits, else pow2 fallback.
+        collate requires N_pad > tot_nodes (>=1 padding node) and
+        E_pad >= tot_edges."""
+        for n, e in self._ladder:
+            if n > tot_nodes and e >= tot_edges:
+                return n, e, False
+        return (
+            round_up_pow2(tot_nodes + 1),
+            round_up_pow2(max(tot_edges, 1)),
+            bool(self._ladder),
+        )
+
+    def _collate(self, entries: List[_Request]) -> _BatchWork:
+        t0 = time.perf_counter()
+        # Queue wait ends at the FLUSH (now), before collation starts — the
+        # stage decomposition must not double-count collate seconds.
+        for r in entries:
+            self.metrics.observe("queue_wait", t0 - r.t_submit)
+        samples = [r.sample for r in entries]
+        arena = GraphArena(samples)
+        tot_nodes = int(arena.ns.sum())
+        tot_edges = int(arena.es.sum())
+        n_pad, e_pad, fallback = self._bucket_shape(tot_nodes, tot_edges)
+        batch = arena.collate(
+            np.arange(len(samples)),
+            num_nodes_pad=n_pad,
+            num_edges_pad=e_pad,
+            num_graphs_pad=self._g_pad,
+            edge_dim=self._edge_dim,
+        )
+        self.metrics.observe("collate", time.perf_counter() - t0)
+        self.metrics.record_batch(
+            len(entries), self.max_batch_graphs, tot_nodes, n_pad,
+            tot_edges, e_pad,
+        )
+        if fallback:
+            self.metrics.count("ladder_fallback_total")
+        return _BatchWork(
+            requests=entries,
+            node_start=np.asarray(arena.node_start[:-1], dtype=np.int64),
+            batch=batch,
+            fallback=fallback,
+        )
+
+    def _transfer(self, work: _BatchWork):
+        """DeviceFeed transfer stage: one blocking device_put per batch —
+        batch k+1 commits over DMA while batch k executes."""
+        import jax
+
+        t0 = time.perf_counter()
+        dev = jax.device_put(work.batch)
+        jax.block_until_ready(dev)
+        self.metrics.observe("h2d", time.perf_counter() - t0)
+        self.metrics.count(
+            "h2d_bytes_total",
+            sum(
+                getattr(leaf, "nbytes", 0)
+                for leaf in jax.tree_util.tree_leaves(work.batch)
+            ),
+        )
+        return work, dev
+
+    def _executable_for(self, dev_batch):
+        import jax
+
+        key = (
+            dev_batch.num_nodes_pad,
+            dev_batch.num_edges_pad,
+            dev_batch.num_graphs_pad,
+        )
+        exe = self._executables.get(key)
+        if exe is None:
+            t0 = time.perf_counter()
+            exe = self._jit.lower(self._params, self._bstats, dev_batch).compile()
+            self.metrics.record_compile(time.perf_counter() - t0)
+            self._executables[key] = exe
+        else:
+            self.metrics.count("cache_hits_total")
+        return exe
+
+    def _execute(self, dev_batch) -> List[np.ndarray]:
+        """Run the (cached) compiled executable; host numpy outputs."""
+        import jax
+
+        exe = self._executable_for(dev_batch)
+        t0 = time.perf_counter()
+        outputs = exe(self._params, self._bstats, dev_batch)
+        outputs = jax.block_until_ready(outputs)
+        self.metrics.observe("device", time.perf_counter() - t0)
+        return [np.asarray(o) for o in outputs]
+
+    def _dispatch_loop(self) -> None:
+        try:
+            # The batcher's shutdown marker ends the feed iteration; every
+            # batch flushed before it is still executed and resolved here.
+            for work, dev_batch in self._feed:
+                self._resolve(work, self._execute(dev_batch))
+        except BaseException as e:  # noqa: BLE001 — re-raised at callers
+            self._fail(e)
+
+    def _resolve(self, work: _BatchWork, outputs: List[np.ndarray]) -> None:
+        now = time.perf_counter()
+        for i, req in enumerate(work.requests):
+            per_head: List[np.ndarray] = []
+            for ihead, htype in enumerate(self.model.output_type):
+                out = outputs[ihead]
+                if htype == "graph":
+                    val = out[i]
+                else:
+                    start = int(work.node_start[i])
+                    val = out[start : start + req.sample.num_nodes]
+                per_head.append(self._denormalize(ihead, val))
+            with self._lock:
+                self._pending.discard(req.future)
+            req.future.set_result(per_head)
+            self.metrics.observe("e2e", now - req.t_submit)
+
+    def _denormalize(self, ihead: int, value: np.ndarray) -> np.ndarray:
+        if self._y_minmax is None:
+            return value
+        ymin = np.asarray(self._y_minmax[ihead][0])
+        ymax = np.asarray(self._y_minmax[ihead][1])
+        return value * (ymax - ymin) + ymin
+
+    def _reject(self, req: _Request, exc: BaseException) -> None:
+        with self._lock:
+            self._pending.discard(req.future)
+        req.future.set_exception(exc)
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, set()
+        for fut in pending:
+            fut.set_exception(exc)
+
+    def _fail(self, exc: BaseException) -> None:
+        """A worker thread died: poison the engine and fail every pending
+        future so no caller blocks forever (the 'never wedge the queue'
+        contract)."""
+        if isinstance(exc, EngineClosedError) or (
+            self._closing.is_set() and self._error is None
+        ):
+            self._fail_pending(EngineClosedError("engine closed"))
+            return
+        self._error = exc
+        self.metrics.count("errors_total")
+        self._closing.set()
+        # Drain queued requests that never reached a batch.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _SHUTDOWN:
+                self._reject(req, exc)
+        self._fail_pending(exc)
+        if self._feed is not None:
+            self._feed.close()
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self, ladder: Optional[Sequence[Tuple[int, int]]] = None) -> int:
+        """AOT-compile every (declared or constructor) ladder bucket so
+        steady-state traffic never pays a compile. An explicitly passed
+        ladder is MERGED into the engine's bucket ladder — a warmed shape
+        _bucket_shape can never select would be wasted compile time.
+        Returns the number of executables compiled."""
+        if ladder:
+            self._ladder = sorted(
+                set(self._ladder) | {(int(n), int(e)) for n, e in ladder}
+            )
+        compiled = 0
+        # Iterate the MERGED ladder: constructor-declared buckets still cold
+        # at this point must warm too, as the docstring promises.
+        for n_pad, e_pad in self._ladder:
+            key = (int(n_pad), int(e_pad), self._g_pad)
+            if key in self._executables:
+                continue
+            batch = self._dummy_batch(int(n_pad), int(e_pad))
+            t0 = time.perf_counter()
+            exe = self._jit.lower(self._params, self._bstats, batch).compile()
+            self.metrics.record_compile(time.perf_counter() - t0)
+            self._executables[key] = exe
+            compiled += 1
+        return compiled
+
+    def _dummy_batch(self, n_pad: int, e_pad: int):
+        """Structurally-real batch of one 1-node graph at the given pads —
+        shape/dtype/pytree-identical to live traffic's batches."""
+        s = GraphSample(
+            x=np.zeros((1, self.model.input_dim), np.float32),
+            pos=np.zeros((1, 3), np.float32),
+            edge_index=np.zeros((2, 1), np.int32),
+            edge_attr=np.zeros((1, max(self._edge_dim, 1)), np.float32)
+            if self._edge_dim
+            else None,
+        )
+        return GraphArena([s]).collate(
+            np.array([0]),
+            num_nodes_pad=n_pad,
+            num_edges_pad=e_pad,
+            num_graphs_pad=self._g_pad,
+            edge_dim=self._edge_dim,
+        )
+
+    # ------------------------------------------------------- checkpoint load
+    @classmethod
+    def from_config(
+        cls,
+        config,
+        checkpoint: Optional[str] = None,
+        checkpoint_format: str = "auto",
+        logs_path: str = "./logs/",
+        **options,
+    ) -> "InferenceEngine":
+        """Build an engine from a COMPLETED config (the snapshot
+        ``run_training`` writes to ``logs/<name>/config.json`` — it must
+        already carry input_dim/output_dim/output_type/pna_deg etc., since
+        serving has no datasets to re-run config completion against).
+
+        ``checkpoint`` is a path to either a native flax checkpoint
+        (utils/model.save_model payload) or a reference torch ``.pk``
+        (mapped through utils/torch_import); ``"auto"`` sniffs the format.
+        ``checkpoint=None`` restores this framework's own
+        ``logs/<log_name>/<log_name>.pk`` derived from the config. For torch
+        checkpoints with ``num_sharedlayers > 1`` the model is built with the
+        reference shared-MLP activation layout (models/layers.MLP
+        ``inner_activation=False``) so imported forwards are exact.
+        """
+        from ..models.create import create_model_config, init_model_variables, make_example_batch
+        from ..utils.config_utils import get_log_name_config
+        from ..utils.model import load_checkpoint_file, load_existing_model
+
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        arch = dict(config["NeuralNetwork"]["Architecture"])
+        for required in ("input_dim", "output_dim", "output_type"):
+            if required not in arch:
+                raise ValueError(
+                    f"config is not completed (missing Architecture."
+                    f"{required}) — pass the logs/<name>/config.json "
+                    "snapshot run_training wrote, not the raw input config"
+                )
+
+        fmt = checkpoint_format
+        if fmt == "auto":
+            fmt = "native" if checkpoint is None else cls._sniff_format(checkpoint)
+        if fmt not in ("native", "torch"):
+            raise ValueError(f"unknown checkpoint_format {fmt!r}")
+        if fmt == "torch" and checkpoint is None:
+            raise ValueError(
+                "checkpoint_format='torch' requires an explicit checkpoint "
+                "path (--ckpt); only native checkpoints can be derived from "
+                "the config's log name"
+            )
+        if fmt == "torch":
+            # The reference's shared-MLP Sequential has no ReLU between its
+            # shared Linears; build the model with that exact layout so the
+            # imported checkpoint serves bit-faithful outputs.
+            heads = json.loads(json.dumps(arch["output_heads"]))
+            if "graph" in heads:
+                heads["graph"]["shared_layout"] = "reference"
+            arch["output_heads"] = heads
+
+        model = create_model_config(config=arch, verbosity=0)
+        example = make_example_batch(
+            arch["input_dim"],
+            arch["output_dim"],
+            arch["output_type"],
+            edge_dim=arch.get("edge_dim"),
+            num_nodes=arch.get("num_nodes") or 4,
+        )
+        variables = init_model_variables(model, example)
+
+        if fmt == "torch":
+            from ..utils.torch_import import import_torch_checkpoint
+
+            variables, report = import_torch_checkpoint(
+                checkpoint, model, variables
+            )
+            if report["caveats"]:
+                raise ValueError(
+                    "torch checkpoint import is not exact for this config: "
+                    + "; ".join(report["caveats"])
+                )
+        elif checkpoint is None:
+            name = get_log_name_config(config)
+            variables, _ = load_existing_model(variables, name, path=logs_path)
+        else:
+            variables, _, _ = load_checkpoint_file(variables, checkpoint)
+
+        voi = config["NeuralNetwork"].get("Variables_of_interest", {})
+        options.setdefault("head_names", voi.get("output_names"))
+        if voi.get("denormalize_output") and voi.get("y_minmax"):
+            options.setdefault("y_minmax", voi["y_minmax"])
+        return cls(model, variables, **options)
+
+    @staticmethod
+    def _sniff_format(path: str) -> str:
+        """Native checkpoints are a plain pickle of {"params": bytes, ...};
+        torch.save writes a zip archive plain pickle cannot read."""
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            if isinstance(payload, dict) and "params" in payload:
+                return "native"
+        except Exception:
+            pass
+        return "torch"

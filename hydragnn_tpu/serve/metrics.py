@@ -1,0 +1,250 @@
+"""Serving metrics: latency histograms, batch-shape counters, and a
+Prometheus text exposition — the observability half of the online engine
+(docs/SERVING.md "Metrics reference").
+
+Everything here is host-side and lock-protected (observations arrive from the
+engine's batcher/transfer/dispatch threads plus every caller thread). Seconds
+observed into the latency histograms are ALSO credited into the existing
+``Timer`` registry (utils/time_utils.py) under ``serve_*`` names, so a process
+that both trains and serves prints one merged timer report.
+
+Histogram design: fixed log-spaced bucket bounds (factor 2 from 100 µs to
+~1638 s) — the standard Prometheus shape. Quantiles are estimated by linear
+interpolation inside the first bucket whose cumulative count covers the
+requested rank; with 2x-spaced bounds the estimate is within 2x of the true
+value, which is the resolution serving SLOs are stated at.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.time_utils import Timer
+
+# 100 µs .. ~1638 s in 2x steps (25 bounds) — covers queue waits on an idle
+# engine through multi-minute pathological stalls.
+_DEFAULT_BOUNDS = tuple(1e-4 * (2.0**i) for i in range(25))
+
+
+class LatencyHistogram:
+    """Fixed-bound histogram of seconds with count/sum and quantile estimates."""
+
+    def __init__(self, bounds: Sequence[float] = _DEFAULT_BOUNDS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self.bounds) + 1)  # +inf overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        seconds = float(seconds)
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if seconds <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += seconds
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Interpolated q-quantile in seconds (None when empty)."""
+        with self._lock:
+            total = self.count
+            counts = list(self._counts)
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            prev_cum = cum
+            cum += c
+            if cum >= rank and c > 0:
+                hi = (
+                    self.bounds[i]
+                    if i < len(self.bounds)
+                    else self.bounds[-1] * 2.0
+                )
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                frac = (rank - prev_cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self.bounds[-1] * 2.0
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            count, total = self.count, self.sum
+        out = {"count": count, "sum_s": round(total, 6)}
+        for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            v = self.quantile(q)
+            out[name + "_ms"] = None if v is None else round(v * 1000.0, 3)
+        return out
+
+    def prometheus_lines(self, name: str, labels: str = "") -> List[str]:
+        """Cumulative-bucket exposition for one histogram."""
+        lab = f"{{{labels}}}" if labels else ""
+
+        def with_le(le: str) -> str:
+            inner = (labels + "," if labels else "") + f'le="{le}"'
+            return f"{{{inner}}}"
+
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self.count, self.sum
+        lines = []
+        cum = 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(f"{name}_bucket{with_le(repr(round(b, 6)))} {cum}")
+        lines.append(f"{name}_bucket{with_le('+Inf')} {count}")
+        lines.append(f"{name}_sum{lab} {total}")
+        lines.append(f"{name}_count{lab} {count}")
+        return lines
+
+
+class ServeMetrics:
+    """All counters/histograms of one ``InferenceEngine``.
+
+    Latency stages (per docs/SERVING.md):
+      queue_wait — submit() to the request joining a flushed micro-batch;
+      collate    — host packing of the micro-batch into its padded arena;
+      h2d        — blocking device_put wire time (pipeline transfer thread);
+      device     — compiled executable dispatch + readback;
+      e2e        — submit() to future resolution.
+    """
+
+    _STAGES = ("queue_wait", "collate", "h2d", "device", "e2e")
+
+    def __init__(self):
+        self.latency = {s: LatencyHistogram() for s in self._STAGES}
+        self._lock = threading.Lock()
+        # Counters (monotonic).
+        self.requests_total = 0
+        self.rejected_total = 0
+        self.errors_total = 0
+        self.batches_total = 0
+        self.graphs_total = 0
+        self.cache_hits_total = 0
+        self.cache_misses_total = 0
+        self.ladder_fallback_total = 0  # batches whose shape missed the ladder
+        self.compile_seconds_total = 0.0
+        self.h2d_bytes_total = 0
+        # Occupancy / padding accumulators (averages derived in snapshot()).
+        self._occupancy_sum = 0.0
+        self._node_fill_sum = 0.0
+        self._edge_fill_sum = 0.0
+
+    # ------------------------------------------------------------- recorders
+    def observe(self, stage: str, seconds: float) -> None:
+        self.latency[stage].observe(seconds)
+        Timer.credit(f"serve_{stage}", seconds)
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def record_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.cache_misses_total += 1
+            self.compile_seconds_total += seconds
+        Timer.credit("serve_compile", seconds)
+
+    def record_batch(
+        self,
+        num_graphs: int,
+        max_batch_graphs: int,
+        real_nodes: int,
+        n_pad: int,
+        real_edges: int,
+        e_pad: int,
+    ) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.graphs_total += num_graphs
+            self._occupancy_sum += num_graphs / max(max_batch_graphs, 1)
+            self._node_fill_sum += real_nodes / max(n_pad, 1)
+            self._edge_fill_sum += real_edges / max(e_pad, 1)
+
+    # -------------------------------------------------------------- reporters
+    def snapshot(self) -> Dict:
+        with self._lock:
+            batches = self.batches_total
+            out = {
+                "requests_total": self.requests_total,
+                "rejected_total": self.rejected_total,
+                "errors_total": self.errors_total,
+                "batches_total": batches,
+                "graphs_total": self.graphs_total,
+                "bucket_cache": {
+                    "hits": self.cache_hits_total,
+                    "misses": self.cache_misses_total,
+                    "compile_seconds": round(self.compile_seconds_total, 4),
+                    "ladder_fallbacks": self.ladder_fallback_total,
+                },
+                "h2d_bytes_total": self.h2d_bytes_total,
+                "batch_occupancy_mean": round(
+                    self._occupancy_sum / batches, 4
+                )
+                if batches
+                else None,
+                # Padding waste = 1 - fill: the share of padded rows that
+                # carried no real node/edge (compiled FLOPs spent on padding).
+                "padding_waste_nodes_mean": round(
+                    1.0 - self._node_fill_sum / batches, 4
+                )
+                if batches
+                else None,
+                "padding_waste_edges_mean": round(
+                    1.0 - self._edge_fill_sum / batches, 4
+                )
+                if batches
+                else None,
+            }
+        out["latency_ms"] = {s: h.snapshot() for s, h in self.latency.items()}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text-format exposition (the /metrics payload)."""
+        p = "hydragnn_serve"
+        lines = [
+            f"# TYPE {p}_requests_total counter",
+            f"{p}_requests_total {self.requests_total}",
+            f"# TYPE {p}_rejected_total counter",
+            f"{p}_rejected_total {self.rejected_total}",
+            f"# TYPE {p}_errors_total counter",
+            f"{p}_errors_total {self.errors_total}",
+            f"# TYPE {p}_batches_total counter",
+            f"{p}_batches_total {self.batches_total}",
+            f"# TYPE {p}_graphs_total counter",
+            f"{p}_graphs_total {self.graphs_total}",
+            f"# TYPE {p}_bucket_cache_hits_total counter",
+            f"{p}_bucket_cache_hits_total {self.cache_hits_total}",
+            f"# TYPE {p}_bucket_cache_misses_total counter",
+            f"{p}_bucket_cache_misses_total {self.cache_misses_total}",
+            f"# TYPE {p}_ladder_fallback_total counter",
+            f"{p}_ladder_fallback_total {self.ladder_fallback_total}",
+            f"# TYPE {p}_compile_seconds_total counter",
+            f"{p}_compile_seconds_total {self.compile_seconds_total}",
+            f"# TYPE {p}_h2d_bytes_total counter",
+            f"{p}_h2d_bytes_total {self.h2d_bytes_total}",
+        ]
+        snap = self.snapshot()
+        for gauge in (
+            "batch_occupancy_mean",
+            "padding_waste_nodes_mean",
+            "padding_waste_edges_mean",
+        ):
+            v = snap[gauge]
+            if v is not None:
+                lines.append(f"# TYPE {p}_{gauge} gauge")
+                lines.append(f"{p}_{gauge} {v}")
+        lines.append(f"# TYPE {p}_latency_seconds histogram")
+        for stage, hist in self.latency.items():
+            lines.extend(
+                hist.prometheus_lines(
+                    f"{p}_latency_seconds", labels=f'stage="{stage}"'
+                )
+            )
+        return "\n".join(lines) + "\n"

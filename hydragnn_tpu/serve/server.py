@@ -1,0 +1,220 @@
+"""Stdlib-only HTTP front end for the inference engine (docs/SERVING.md).
+
+Endpoints:
+  POST /predict  — JSON graphs in, per-head predictions out (200);
+                   400 on malformed input, 429 + Retry-After under
+                   backpressure, 503 after a worker failure.
+  GET  /healthz  — liveness + queue depth (JSON).
+  GET  /metrics  — Prometheus text exposition of the serving metrics.
+
+Deliberately ``http.server`` (ThreadingHTTPServer): the container bakes no
+web framework, and the engine does all the concurrency work — each handler
+thread only parses JSON, blocks on its requests' futures, and serializes the
+answer. Request batching across connections happens INSIDE the engine, so
+even this simple threaded server gets micro-batched device execution.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import numpy as np
+
+from ..graphs.sample import GraphSample
+from .engine import BackpressureError, EngineFailedError, InferenceEngine
+
+
+def parse_graph(doc: dict) -> GraphSample:
+    """One request graph: {"x": [[...]], "edge_index": [[s...],[r...]],
+    "edge_attr": [[...]]?, "pos": [[...]]?}."""
+    if not isinstance(doc, dict) or "x" not in doc:
+        raise ValueError('each graph must be an object with an "x" field')
+    x = np.asarray(doc["x"], dtype=np.float32)
+    if x.ndim != 2:
+        raise ValueError('"x" must be a [num_nodes, F] nested list')
+    edge_index = None
+    if doc.get("edge_index") is not None:
+        edge_index = np.asarray(doc["edge_index"], dtype=np.int32)
+        if edge_index.ndim != 2 or edge_index.shape[0] != 2:
+            raise ValueError('"edge_index" must be [2, num_edges]')
+        if edge_index.size and (
+            edge_index.min() < 0 or edge_index.max() >= x.shape[0]
+        ):
+            raise ValueError('"edge_index" references nodes outside "x"')
+    edge_attr = None
+    if doc.get("edge_attr") is not None:
+        edge_attr = np.asarray(doc["edge_attr"], dtype=np.float32)
+        if edge_attr.ndim != 2 or (
+            edge_index is not None and edge_attr.shape[0] != edge_index.shape[1]
+        ):
+            raise ValueError('"edge_attr" must be [num_edges, D]')
+    pos = None
+    if doc.get("pos") is not None:
+        pos = np.asarray(doc["pos"], dtype=np.float32)
+    return GraphSample(x=x, pos=pos, edge_index=edge_index, edge_attr=edge_attr)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Engine injected by InferenceServer via the server object.
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def engine(self) -> InferenceEngine:
+        return self.server.engine  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _send_json(self, code: int, payload: dict, headers: Optional[dict] = None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, content_type: str):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ---------------------------------------------------------------- routes
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            engine = self.engine
+            self._send_json(
+                200 if engine.running else 503,
+                {
+                    "ok": engine.running,
+                    "queue_depth": engine._queue.qsize(),
+                    "queue_limit": engine.queue_limit,
+                    "compiled_buckets": len(engine._executables),
+                },
+            )
+        elif self.path == "/metrics":
+            self._send_text(
+                200,
+                self.engine.metrics.render_prometheus(),
+                "text/plain; version=0.0.4",
+            )
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        # Always drain the body first: HTTP/1.1 keep-alive would otherwise
+        # parse leftover body bytes as the NEXT request line after a 404.
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length) if length else b""
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            doc = json.loads(body or b"{}")
+            graphs_doc = doc.get("graphs")
+            if not isinstance(graphs_doc, list) or not graphs_doc:
+                raise ValueError('body must be {"graphs": [<graph>, ...]}')
+            samples = [parse_graph(g) for g in graphs_doc]
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": str(e)})
+            return
+
+        engine = self.engine
+        try:
+            results = engine.predict(
+                samples, timeout=getattr(self.server, "request_timeout_s", 60.0)
+            )
+        except BackpressureError as e:
+            self._send_json(
+                429,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                headers={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+            return
+        except (ValueError, TypeError) as e:  # per-graph validation
+            self._send_json(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except (EngineFailedError, RuntimeError) as e:
+            self._send_json(503, {"error": str(e)})
+            return
+
+        self._send_json(
+            200,
+            {
+                "heads": [
+                    {"name": name, "type": htype, "dim": int(dim)}
+                    for name, htype, dim in zip(
+                        engine.head_names,
+                        engine.model.output_type,
+                        engine.model.output_dim,
+                    )
+                ],
+                "predictions": [
+                    [np.asarray(h).tolist() for h in per_graph]
+                    for per_graph in results
+                ],
+            },
+        )
+
+
+class InferenceServer:
+    """ThreadingHTTPServer wrapper owning one engine.
+
+    ``port=0`` binds an ephemeral port (tests); ``.port`` reports the bound
+    one. ``serve_forever`` blocks; ``start_background`` runs it on a daemon
+    thread and returns immediately.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        request_timeout_s: float = 60.0,
+        verbose: bool = False,
+    ):
+        self.engine = engine
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.engine = engine  # type: ignore[attr-defined]
+        self._httpd.verbose = verbose  # type: ignore[attr-defined]
+        self._httpd.request_timeout_s = request_timeout_s  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def start_background(self) -> "InferenceServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="hydragnn-serve-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, close_engine: bool = True) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        if close_engine:
+            self.engine.close()

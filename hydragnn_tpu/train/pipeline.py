@@ -23,6 +23,12 @@ pipeline backpressure, and it makes the recorded H2D seconds the true wire
 time rather than the (async) dispatch time. Those seconds land in
 ``FeedStats`` — the per-epoch transfer-vs-compute split surfaced through
 ``Timer``/``Profiler`` and reported by bench.py next to the throughput.
+
+Consumers: every epoch-level TrainingDriver path (train_epoch, the chunked
+scan, evaluate) AND the online inference engine (serve/engine.py), whose
+micro-batcher generator runs as the host stage and whose dispatch thread is
+the consumer — the serving path gets the same batch-k+1-commits-while-k-
+computes overlap as a training epoch.
 """
 
 from __future__ import annotations

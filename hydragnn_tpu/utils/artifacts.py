@@ -1,0 +1,21 @@
+"""Round-artifact naming convention, shared by benchmarks and tests.
+
+Per-round artifacts (LARGEGRAPH_rNN.json, SERVE_rNN.json, ...) key their
+filename on the driver-exported HYDRAGNN_ROUND environment variable; one
+helper so the convention (zero-padded, single fallback default) cannot drift
+between writers.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Bump alongside the repo's round cadence: used only when the driver did not
+# export HYDRAGNN_ROUND (e.g. a by-hand test run).
+_FALLBACK_ROUND = "06"
+
+
+def round_tag() -> str:
+    """Two-digit round tag for artifact filenames, e.g. "06"."""
+    tag = os.environ.get("HYDRAGNN_ROUND", "")
+    return tag.zfill(2) if tag.isdigit() else _FALLBACK_ROUND

@@ -57,6 +57,28 @@ def save_model(
     os.replace(tmp_name, path_name)
 
 
+def load_checkpoint_file(
+    variables: Dict[str, Any], path_name: str, opt_state: Any = None
+):
+    """Restore one checkpoint FILE (the save_model payload) onto a variables
+    template. The single deserialization implementation — the log-name
+    convenience below and direct-path consumers (serve engine) share it, so
+    a payload-schema change cannot diverge them. Returns
+    (variables, opt_state, meta)."""
+    with open(path_name, "rb") as f:
+        payload = pickle.load(f)
+    new_vars = dict(variables)
+    new_vars["params"] = serialization.from_bytes(
+        variables["params"], payload["params"]
+    )
+    new_vars["batch_stats"] = serialization.from_bytes(
+        variables.get("batch_stats", {}), payload["batch_stats"]
+    )
+    if opt_state is not None and payload.get("opt_state") is not None:
+        opt_state = serialization.from_bytes(opt_state, payload["opt_state"])
+    return new_vars, opt_state, payload.get("meta") or {}
+
+
 def load_existing_model(
     variables: Dict[str, Any],
     model_name: str,
@@ -68,19 +90,11 @@ def load_existing_model(
     single-file checkpoint (model.py:63-78). Returns (variables, opt_state), plus
     the progress meta dict when ``return_meta`` (one file read, not two)."""
     path_name = os.path.join(path, model_name, model_name + ".pk")
-    with open(path_name, "rb") as f:
-        payload = pickle.load(f)
-    params = serialization.from_bytes(variables["params"], payload["params"])
-    bstats = serialization.from_bytes(
-        variables.get("batch_stats", {}), payload["batch_stats"]
+    new_vars, opt_state, meta = load_checkpoint_file(
+        variables, path_name, opt_state
     )
-    new_vars = dict(variables)
-    new_vars["params"] = params
-    new_vars["batch_stats"] = bstats
-    if opt_state is not None and payload.get("opt_state") is not None:
-        opt_state = serialization.from_bytes(opt_state, payload["opt_state"])
     if return_meta:
-        return new_vars, opt_state, payload.get("meta") or {}
+        return new_vars, opt_state, meta
     return new_vars, opt_state
 
 

@@ -22,11 +22,16 @@ Weight-layout notes (verified in the round-trip test):
   well-defined mapping onto optax state for a re-designed tree); training
   resumed here starts with fresh optimizer state.
 
-Structural caveat: for ``num_sharedlayers > 1`` the reference's shared-MLP
-Sequential has no ReLU between its first two Linears (Base.py:155-162 appends
-[ReLU, Linear, Linear, ReLU]); this framework's MLP activates between every
-pair. Weights still transfer 1:1 by Linear order, but forward parity is exact
-only for single-shared-layer configs — flagged in the returned report.
+Shared-MLP layout: the reference's shared-MLP Sequential has no ReLU between
+its Linears (Base.py:155-162 appends [ReLU, Linear, ..., Linear, ReLU] —
+activation only before the first Linear, a no-op on the non-negative pooled
+input, and after the last). Build the model with
+``output_heads.graph.shared_layout = "reference"`` (models/layers.MLP
+``inner_activation=False``) and imported forwards are EXACT for any
+``num_sharedlayers`` (locked at fp32 tolerance by
+tests/test_torch_import_numeric.py); the framework's default layout
+(ReLU between every pair) is only flagged as a caveat when the two grammars
+actually diverge, i.e. ``num_sharedlayers > 1``.
 """
 
 from __future__ import annotations
@@ -282,11 +287,16 @@ def import_torch_checkpoint(
             sd, "graph_shared", params["graph_shared"], consumed
         )
         n_shared = len(params["graph_shared"])
-        if n_shared > 1:
+        shared_layout = model.config_heads.get("graph", {}).get(
+            "shared_layout", "framework"
+        )
+        if n_shared > 1 and shared_layout != "reference":
             caveats.append(
-                "num_sharedlayers > 1: reference Sequential lacks the "
-                "inter-Linear ReLU this framework applies — weights "
-                "transferred 1:1 but forward outputs will differ"
+                "num_sharedlayers > 1 with the framework shared-MLP layout: "
+                "the reference Sequential lacks the inter-Linear ReLU — "
+                "weights transferred 1:1 but forward outputs will differ; "
+                'build the model with output_heads.graph.shared_layout = '
+                '"reference" for exact parity'
             )
 
     # --- per-head MLPs ---

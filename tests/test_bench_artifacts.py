@@ -83,3 +83,36 @@ def pytest_pallas_matrix_schema_readable_both_ways():
     assert scatter_row_is_pallas({"arm": "pallas", "pallas": True})
     assert not scatter_row_is_pallas({"arm": "xla", "pallas": False})
     assert not scatter_row_is_pallas({"arm": "sorted"})
+
+
+def pytest_last_known_serving_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_serving
+
+    real = {
+        "saturation_graphs_per_sec": 1200.0,
+        "closed_loop": {"p95_ms": 9.5},
+        "recompiles_after_warmup": 0,
+        "platform": "cpu",
+    }
+    (tmp_path / "SERVE_r06.json").write_text(json.dumps(real))
+    # A failed --serve round writes no saturation number — never "last known".
+    (tmp_path / "SERVE_r07.json").write_text(
+        json.dumps({"error": "TimeoutError", "saturation_graphs_per_sec": 0.0})
+    )
+    now = time.time()
+    os.utime(tmp_path / "SERVE_r06.json", (now - 50, now - 50))
+    os.utime(tmp_path / "SERVE_r07.json", (now - 10, now - 10))
+
+    blk = _last_known_serving(str(tmp_path))
+    assert blk is not None
+    assert blk["saturation_graphs_per_sec"] == 1200.0
+    assert blk["closed_loop_p95_ms"] == 9.5
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "SERVE_r06.json"
+
+
+def pytest_last_known_serving_none_when_no_measurements(tmp_path):
+    from bench import _last_known_serving
+
+    (tmp_path / "SERVE_bad.json").write_text("{not json")
+    assert _last_known_serving(str(tmp_path)) is None

@@ -23,6 +23,7 @@ sys.path.insert(0, REPO)
 
 import hydragnn_tpu
 from hydragnn_tpu.parallel.distributed import make_mesh
+from hydragnn_tpu.utils.artifacts import round_tag
 from tests.deterministic_graph_data import deterministic_graph_data
 
 ATOMS = 1024  # 8 x 8 x 8 BCC cells x 2 atoms
@@ -72,8 +73,11 @@ def _in_workdir(workdir, fn):
 def _train(mesh):
     config = _config()
     t0 = time.perf_counter()
-    hydragnn_tpu.run_training(config, mesh=mesh)
-    return round(time.perf_counter() - t0, 2)
+    history = hydragnn_tpu.run_training(config, mesh=mesh)
+    return round(time.perf_counter() - t0, 2), {
+        k: [round(float(v), 6) for v in history[k]]
+        for k in ("total_loss_train", "total_loss_val", "total_loss_test")
+    }
 
 
 def _predict(mesh):
@@ -104,7 +108,7 @@ def pytest_largegraph_graph_axis_equivalence(tmp_path, monkeypatch, agg_arm):
     # TRAINING trajectories is chaotic -- ~6 AdamW steps amplify 1e-7
     # reduction noise to percent-level eval differences.)
     d = tmp_path / "single"
-    train_single_s = _in_workdir(d, lambda: _train(None))
+    train_single_s, curves_single = _in_workdir(d, lambda: _train(None))
     eval_single = _in_workdir(d, lambda: _predict(None))
     eval_sharded_same_ckpt = _in_workdir(d, lambda: _predict(mesh4))
     assert np.isfinite(eval_single["error"])
@@ -117,12 +121,25 @@ def pytest_largegraph_graph_axis_equivalence(tmp_path, monkeypatch, agg_arm):
         assert abs(a - b) <= 1e-3 * max(abs(a), 1.0)
 
     # (2) The full high-level training path under graph sharding runs end to
-    # end and lands in the same accuracy regime.
+    # end and must land within a SCATTER ALLOWANCE of the same-seed
+    # single-device result (same config, same init seed, same data): the two
+    # trajectories differ only by fp32 reduction order and the DP dropout-key
+    # fold, which over this test's ~6 AdamW steps produces percent-level —
+    # not multiple-of — eval differences. Allowance: 1.35x relative + 0.02
+    # absolute (observed ratio across rounds is ~0.7-1.1x; r05 recorded
+    # sharded 0.204 vs single 0.301). The old fixed 0.5 ceiling is KEPT as
+    # the outer min() backstop: a regression that degrades both arms equally
+    # would satisfy any purely relative gate.
     d2 = tmp_path / "sharded"
-    train_sharded_s = _in_workdir(d2, lambda: _train(mesh4))
+    train_sharded_s, curves_sharded = _in_workdir(d2, lambda: _train(mesh4))
     eval_after_sharded_train = _in_workdir(d2, lambda: _predict(mesh4))
     assert np.isfinite(eval_after_sharded_train["error"])
-    assert eval_after_sharded_train["error"] < 0.5, eval_after_sharded_train
+    quality_bound = min(1.35 * eval_single["error"] + 0.02, 0.5)
+    assert eval_after_sharded_train["error"] <= quality_bound, (
+        eval_after_sharded_train,
+        eval_single,
+        quality_bound,
+    )
 
     epochs = _config()["NeuralNetwork"]["Training"]["num_epoch"]
     artifact = {
@@ -137,8 +154,17 @@ def pytest_largegraph_graph_axis_equivalence(tmp_path, monkeypatch, agg_arm):
         "eval_single": eval_single,
         "eval_sharded_same_ckpt": eval_sharded_same_ckpt,
         "eval_after_sharded_train": eval_after_sharded_train,
-        "note": "same-checkpoint eval agreement asserted to 1e-3; virtual "
-        "CPU mesh timings are plumbing canaries, not scaling evidence",
+        "quality_bound_vs_single": round(float(quality_bound), 6),
+        # Per-epoch loss curves of both arms — the trajectory-level evidence
+        # behind the relative quality gate above.
+        "curves_single": curves_single,
+        "curves_graph4": curves_sharded,
+        "note": "same-checkpoint eval agreement asserted to 1e-3; sharded-"
+        "train error gated at 1.35x single-device + 0.02 (documented "
+        "scatter allowance); virtual CPU mesh timings are plumbing "
+        "canaries, not scaling evidence",
     }
-    with open(os.path.join(REPO, "LARGEGRAPH_r05.json"), "w") as f:
+    with open(
+        os.path.join(REPO, f"LARGEGRAPH_r{round_tag()}.json"), "w"
+    ) as f:
         json.dump(artifact, f, indent=2)

@@ -270,3 +270,220 @@ def pytest_numeric_parity_pna():
     )
     mapped = _map_conv("PNA", _pfx(sd), "c", _template(conv, x_np, e_np), set())
     _check("PNA", ref, _apply_flax(conv, mapped, x_np, e_np))
+
+
+# ---------------------------------------------------------------------------
+# Full-model parity for num_sharedlayers=2 (ISSUE 2 satellite): the reference
+# shared-MLP Sequential is [ReLU, Linear, Linear, ReLU] (Base.py:155-162) —
+# no ReLU between the shared Linears. With the model built in the
+# reference-grammar layout (output_heads.graph.shared_layout="reference"),
+# the imported checkpoint must reproduce the torch forward END TO END
+# (2 PNA convs + eval BatchNorms + mean pool + shared MLP + graph head) at
+# fp32 tolerance and with an empty caveat list.
+# ---------------------------------------------------------------------------
+
+SHARED2, HEADH2 = 5, 7
+
+
+def _pna_layer_sd(gen, f_in, f_out, agg_scale=16):
+    sd = {}
+    for prefix, (o, i) in {
+        "pre_nns.0.0": (f_in, 3 * f_in),
+        "edge_encoder": (f_in, EDGE),
+        "post_nns.0.0": (f_out, (agg_scale + 1) * f_in),
+        "lin": (f_out, f_out),
+    }.items():
+        for k, v in _lin(gen, o, i).items():
+            sd[f"{prefix}.{k}"] = v
+    return sd
+
+
+def _bn_sd(gen, width):
+    return {
+        "module.weight": torch.tensor(
+            gen.uniform(0.5, 1.5, width).astype(np.float32)
+        ),
+        "module.bias": torch.tensor(gen.normal(size=width).astype(np.float32)),
+        "module.running_mean": torch.tensor(
+            gen.normal(size=width).astype(np.float32)
+        ),
+        "module.running_var": torch.tensor(
+            gen.uniform(0.5, 2.0, width).astype(np.float32)
+        ),
+        "module.num_batches_tracked": torch.tensor(3),
+    }
+
+
+def _torch_pna_conv(sd, prefix, x, e, avg_log, avg_lin):
+    """One reference PNAConv forward (same semantics as
+    pytest_numeric_parity_pna, parameterized by layer prefix)."""
+    f_in = x.shape[1]
+    recv = torch.tensor(RECEIVERS, dtype=torch.long)
+    z = torch.cat(
+        [x[RECEIVERS], x[SENDERS], _lin_t(sd, f"{prefix}.edge_encoder", e)], -1
+    )
+    m = _lin_t(sd, f"{prefix}.pre_nns.0.0", z)
+    deg = _degree(recv, N)
+    mean = _scatter_sum(m, recv, N) / deg.clamp(min=1.0)[:, None]
+    mn = torch.full((N, f_in), torch.inf).scatter_reduce(
+        0, recv[:, None].expand(-1, f_in), m, "amin", include_self=False
+    )
+    mx = torch.full((N, f_in), -torch.inf).scatter_reduce(
+        0, recv[:, None].expand(-1, f_in), m, "amax", include_self=False
+    )
+    var = _scatter_sum(m * m, recv, N) / deg.clamp(min=1.0)[:, None] - mean**2
+    std = torch.sqrt(torch.relu(var) + 1e-5)
+    aggs = torch.cat([mean, mn, mx, std], -1)
+    d = deg.clamp(min=1.0)[:, None]
+    scaled = torch.cat(
+        [
+            aggs,
+            aggs * (torch.log(d + 1.0) / avg_log),
+            aggs * (avg_log / torch.log(d + 1.0)),
+            aggs * (d / avg_lin),
+        ],
+        -1,
+    )
+    return _lin_t(
+        sd,
+        f"{prefix}.lin",
+        _lin_t(sd, f"{prefix}.post_nns.0.0", torch.cat([x, scaled], -1)),
+    )
+
+
+def _torch_bn_eval(sd, prefix, x):
+    w = torch.tensor(sd[f"{prefix}.module.weight"])
+    b = torch.tensor(sd[f"{prefix}.module.bias"])
+    rm = torch.tensor(sd[f"{prefix}.module.running_mean"])
+    rv = torch.tensor(sd[f"{prefix}.module.running_var"])
+    return (x - rm) / torch.sqrt(rv + 1e-5) * w + b
+
+
+def _shared2_state_dict(gen):
+    sd = {}
+    for i, f_in in enumerate((F_IN, F_OUT)):
+        for k, v in _pna_layer_sd(gen, f_in, F_OUT).items():
+            sd[f"convs.{i}.{k}"] = v
+        for k, v in _bn_sd(gen, F_OUT).items():
+            sd[f"batch_norms.{i}.{k}"] = v
+    # num_sharedlayers=2: Sequential(ReLU@0, Linear@1, Linear@2, ReLU@3).
+    for k, v in _lin(gen, SHARED2, F_OUT).items():
+        sd[f"graph_shared.1.{k}"] = v
+    for k, v in _lin(gen, SHARED2, SHARED2).items():
+        sd[f"graph_shared.2.{k}"] = v
+    # Graph head Sequential(Linear@0, ReLU, Linear@2, ReLU, Linear@4).
+    for idx, (o, i) in zip(
+        (0, 2, 4), ((HEADH2, SHARED2), (HEADH2, HEADH2), (1, HEADH2))
+    ):
+        for k, v in _lin(gen, o, i).items():
+            sd[f"heads_NN.0.{idx}.{k}"] = v
+    return _np_sd(sd)
+
+
+def _shared2_model(shared_layout):
+    from hydragnn_tpu.models.create import create_model
+
+    deg_per_node = np.bincount(RECEIVERS, minlength=N)
+    output_heads = {
+        "graph": {
+            "num_sharedlayers": 2,
+            "dim_sharedlayers": SHARED2,
+            "num_headlayers": 2,
+            "dim_headlayers": [HEADH2, HEADH2],
+        }
+    }
+    if shared_layout is not None:
+        output_heads["graph"]["shared_layout"] = shared_layout
+    return create_model(
+        model_type="PNA",
+        input_dim=F_IN,
+        hidden_dim=F_OUT,
+        output_dim=[1],
+        output_type=["graph"],
+        output_heads=output_heads,
+        task_weights=[1.0],
+        num_conv_layers=2,
+        edge_dim=EDGE,
+        pna_deg=np.bincount(deg_per_node),
+    ), pna_degree_averages(np.bincount(deg_per_node))
+
+
+def _shared2_batch(x_np, e_np):
+    from hydragnn_tpu.graphs.collate import GraphSample, collate_graphs
+
+    sample = GraphSample(
+        x=x_np,
+        pos=np.zeros((N, 3), np.float32),
+        y=np.zeros(1, np.float32),
+        y_loc=np.array([[0, 1]], np.int64),
+        edge_index=np.stack([SENDERS, RECEIVERS]),
+        edge_attr=e_np,
+    )
+    return collate_graphs(
+        [sample], head_types=["graph"], head_dims=[1], edge_dim=EDGE
+    )
+
+
+def pytest_numeric_parity_num_sharedlayers2_reference_layout(tmp_path):
+    from hydragnn_tpu.models.create import init_model_variables
+    from hydragnn_tpu.utils.torch_import import import_torch_checkpoint
+
+    gen = np.random.default_rng(17)
+    x_np, e_np = _graph(gen)
+    sd = _shared2_state_dict(gen)
+    path = tmp_path / "shared2.pk"
+    torch.save({"model_state_dict": {k: torch.tensor(v) for k, v in sd.items()}}, str(path))
+
+    model, (avg_log, avg_lin) = _shared2_model("reference")
+    batch = _shared2_batch(x_np, e_np)
+    variables = init_model_variables(model, batch, seed=0)
+    new_vars, report = import_torch_checkpoint(str(path), model, variables)
+    assert report["caveats"] == [], report["caveats"]
+    assert report["ignored"] == [], report["ignored"]
+
+    # Reference torch forward, straight from the module grammar.
+    x, e = torch.tensor(x_np), torch.tensor(e_np)
+    for i in range(2):
+        x = _torch_pna_conv(sd, f"convs.{i}", x, e, avg_log, avg_lin)
+        x = torch.relu(_torch_bn_eval(sd, f"batch_norms.{i}", x))
+    xg = x.mean(dim=0, keepdim=True)  # global mean pool, one graph
+    # graph_shared = Sequential(ReLU, Linear, Linear, ReLU): NO inner ReLU.
+    xs = torch.relu(
+        _lin_t(sd, "graph_shared.2", _lin_t(sd, "graph_shared.1", torch.relu(xg)))
+    )
+    ref = _lin_t(
+        sd,
+        "heads_NN.0.4",
+        torch.relu(
+            _lin_t(sd, "heads_NN.0.2", torch.relu(_lin_t(sd, "heads_NN.0.0", xs)))
+        ),
+    )
+
+    out = np.asarray(model.apply(new_vars, batch, train=False)[0])[:1]
+    np.testing.assert_allclose(
+        out,
+        ref.numpy(),
+        rtol=2e-4,
+        atol=2e-4,
+        err_msg="num_sharedlayers=2 reference-layout import diverges from "
+        "the reference torch forward",
+    )
+
+
+def pytest_num_sharedlayers2_framework_layout_still_caveats(tmp_path):
+    """The default (framework) layout applies an inner ReLU the reference
+    lacks — the importer must keep flagging that divergence."""
+    from hydragnn_tpu.models.create import init_model_variables
+    from hydragnn_tpu.utils.torch_import import import_torch_checkpoint
+
+    gen = np.random.default_rng(18)
+    sd = _shared2_state_dict(gen)
+    path = tmp_path / "shared2_fw.pk"
+    torch.save({"model_state_dict": {k: torch.tensor(v) for k, v in sd.items()}}, str(path))
+
+    model, _ = _shared2_model(None)  # default framework layout
+    x_np, e_np = _graph(gen)
+    batch = _shared2_batch(x_np, e_np)
+    variables = init_model_variables(model, batch, seed=0)
+    _, report = import_torch_checkpoint(str(path), model, variables)
+    assert any("shared_layout" in c for c in report["caveats"]), report
