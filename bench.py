@@ -474,6 +474,62 @@ def _last_known_serving(search_dir: "str | None" = None) -> "dict | None":
     return _latest_artifact_block("SERVE_*.json", extract, search_dir)
 
 
+def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent completed drill matrix from any committed FAULTS_*
+    artifact — the fault-drill analog of ``_last_known_hardware``. A failed
+    ``--faults`` round embeds this block with ``provenance: "stale"``."""
+
+    def extract(doc):
+        if doc.get("metric") != "fault_drills" or not doc.get("drills"):
+            return None
+        return {
+            "value": doc.get("value"),
+            "drills_passed": doc.get("drills_passed"),
+            "drills_total": doc.get("drills_total"),
+            "guard_overhead_pct": doc.get("guard_overhead_pct"),
+            "guard_bit_inert": doc.get("guard_bit_inert"),
+        }
+
+    return _latest_artifact_block("FAULTS_*.json", extract, search_dir)
+
+
+def faults_main() -> int:
+    """``python bench.py --faults``: run the deterministic fault-drill matrix
+    (benchmarks/fault_drills.py) and print it as the round's FAULTS_rNN.json
+    line: per-drill pass/fail + mechanism + counters, guard bit-inertness,
+    and the guard's steady-epoch overhead %. CPU-safe (the drills are seeded
+    and hardware-independent); failure prints a diagnostic line embedding the
+    last known drill matrix, stale-labeled, per the established convention."""
+    result = {
+        "metric": "fault_drills",
+        "value": 0.0,
+        "unit": "drills_passed_frac",
+    }
+    try:
+        import jax
+
+        result["backend"] = jax.default_backend()
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.fault_drills import run_fault_drills
+
+        result.update(run_fault_drills())
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        try:
+            stale = _last_known_faults()
+            if stale is not None:
+                result["last_known_faults"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0 if result["value"] == 1.0 else 1
+
+
 def serve_main() -> int:
     """``python bench.py --serve``: run the online-serving load benchmark
     (benchmarks/serve_load.py) and print its block as the round's serving
@@ -759,4 +815,6 @@ def main():
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         sys.exit(serve_main())
+    if "--faults" in sys.argv:
+        sys.exit(faults_main())
     main()

@@ -19,7 +19,9 @@ from . import (
 from .run_training import run_training
 from .run_prediction import run_prediction
 
-# Imported after the subpackages above: serve builds on models/train/graphs.
-from . import serve
+# Imported after the subpackages above: serve builds on models/train/graphs;
+# faults threads through train/preprocess/serve (fault injection, non-finite
+# guard policy, crash-resume supervisor).
+from . import faults, serve
 
 __version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Fault-tolerance layer: deterministic fault injection, the non-finite step
+guard's host policy, fault-event counters, and the crash-resume supervisor.
+
+The mechanisms themselves are threaded through the layers they protect —
+trainer (guarded compiled step), train_validate_test (guard policy + injection
+hooks), pipeline (retrying transfers), dataloader (sample quarantine),
+utils/model (checkpoint retention + stale-tmp cleanup), serve/engine
+(batch-scoped failures, output guard, worker restarts). This package holds
+what is shared: the plan, the policy, the counters, the supervisor.
+
+See docs/FAULT_TOLERANCE.md for the fault taxonomy, the policy knobs
+(``Training.fault_tolerance``), and the drill how-to (``HYDRAGNN_FAULTS``).
+"""
+
+from .counters import FaultCounters
+from .guard import StepGuard
+from .plan import (
+    ENV_VAR,
+    FaultPlan,
+    InjectedFault,
+    InjectedTransientError,
+)
+from .supervisor import read_supervisor_meta, run_supervised
+
+__all__ = [
+    "ENV_VAR",
+    "FaultCounters",
+    "FaultPlan",
+    "InjectedFault",
+    "InjectedTransientError",
+    "StepGuard",
+    "read_supervisor_meta",
+    "run_supervised",
+]

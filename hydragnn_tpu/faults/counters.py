@@ -1,0 +1,47 @@
+"""Process-wide fault-event counters (docs/FAULT_TOLERANCE.md).
+
+The fault-tolerance layer's observability half: every survival mechanism
+(guard skip, rollback, transfer retry, sample quarantine, supervised restart)
+increments a named counter here when it fires, so "the run survived" is never
+silent — `print_timers` appends the counts to the end-of-run report,
+``bench.py --faults`` embeds the snapshot in the drill artifact, and the
+serving layer mirrors its own engine-scoped counters into Prometheus.
+
+Class-level registry like ``Timer`` (utils/time_utils.py) — counters arrive
+from the pipeline's host/transfer threads, the training driver, and loader
+construction, so increments are lock-protected.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class FaultCounters:
+    """Accumulating named integer counters; class-level registry."""
+
+    _counts: Dict[str, int] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def inc(cls, name: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        with cls._lock:
+            cls._counts[name] = cls._counts.get(name, 0) + int(n)
+
+    @classmethod
+    def get(cls, name: str) -> int:
+        with cls._lock:
+            return cls._counts.get(name, 0)
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, int]:
+        with cls._lock:
+            return dict(cls._counts)
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._counts.clear()

@@ -1,0 +1,161 @@
+"""Non-finite step-guard policy — the host half of the NaN/Inf survival
+mechanism (docs/FAULT_TOLERANCE.md).
+
+The compiled train step (trainer._step_body with ``guard=True``) already
+SKIPPED the update on a non-finite step (params/opt_state/batch_stats keep
+their old values inside the jit, the step's metrics carry zero weight) and
+returned a ``bad`` flag. This policy consumes that flag on the host:
+
+* count bad steps (FaultCounters ``bad_steps``),
+* after ``max_bad_steps`` CONSECUTIVE bad steps, roll the driver back to a
+  retained last-good device-side snapshot and optionally back off the
+  injected learning rate — a *persistent* divergence recovers to known-good
+  state instead of skip-looping forever,
+* refresh the snapshot every epoch (and, optionally, every
+  ``snapshot_every`` good steps).
+
+Scan-path granularity: the chunked ``lax.scan`` epoch reports ``bad`` SUMMED
+per chunk, so consecutive-bad accounting is chunk-level there (a clean chunk
+resets the streak; a chunk with any bad steps extends it by its bad count).
+The skip itself is always exact per step — it lives inside the jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.optimizer import get_learning_rate, set_learning_rate
+from ..utils.print_utils import print_distributed
+from .counters import FaultCounters
+
+
+# ONE dispatch per snapshot: a jitted identity over the array leaves returns
+# fresh output buffers (no donation), so the copy survives the donating train
+# step consuming the originals — per-leaf jnp.array copies would cost a
+# dispatch per leaf every epoch.
+_jit_copy_leaves = jax.jit(lambda xs: [x for x in xs])
+
+
+def _copy_state(state):
+    """Fresh device buffers — the driver's donating steps consume the live
+    state's buffers, so a retained snapshot must never alias them. Non-array
+    leaves (python scalars some optimizer states carry) pass through
+    untouched rather than being traced into arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    arr_idx = [i for i, leaf in enumerate(leaves) if isinstance(leaf, jax.Array)]
+    if arr_idx:
+        copied = _jit_copy_leaves([leaves[i] for i in arr_idx])
+        for i, c in zip(arr_idx, copied):
+            leaves[i] = c
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class StepGuard:
+    """Per-driver skip/rollback policy over the compiled step's ``bad`` flag.
+
+    Parameters (the ``Training.fault_tolerance`` knobs):
+      max_bad_steps:   consecutive bad steps tolerated before a rollback.
+      lr_backoff:      multiply the injected LR by this on rollback
+                       (None/1.0 disables; optimizers without an injected LR
+                       — LBFGS — are left untouched).
+      min_lr:          floor for the backoff.
+      snapshot_every:  additionally refresh the last-good snapshot every N
+                       good steps (0 = epoch-start snapshots only).
+    """
+
+    def __init__(
+        self,
+        max_bad_steps: int = 3,
+        lr_backoff: Optional[float] = 0.5,
+        min_lr: float = 1e-6,
+        snapshot_every: int = 0,
+        verbosity: int = 0,
+    ):
+        self.max_bad_steps = max(1, int(max_bad_steps))
+        self.lr_backoff = lr_backoff
+        self.min_lr = float(min_lr)
+        self.snapshot_every = int(snapshot_every)
+        self.verbosity = verbosity
+        self.bad_steps = 0
+        self.consecutive = 0.0
+        self.rollbacks = 0
+        self._snap = None
+        self._good_since_snap = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def begin_epoch(self, driver) -> None:
+        """Epoch-start snapshot: the rollback target is never older than one
+        epoch (taken BEFORE the first step can donate the buffers away)."""
+        self.take_snapshot(driver.state)
+
+    def take_snapshot(self, state) -> None:
+        self._snap = _copy_state(state)
+        self._good_since_snap = 0
+
+    # ----------------------------------------------------------- the policy
+    def after_update(self, driver, metrics) -> bool:
+        """Consume one step's (or one scan chunk's summed) metrics; returns
+        True when a rollback fired. Reads only ``metrics['bad']`` — already
+        host-synced by the driver's metric accumulation, so the guard adds no
+        extra device round-trip."""
+        bad = float(metrics.get("bad", 0.0))
+        if bad <= 0.0:
+            self.consecutive = 0.0
+            self._good_since_snap += 1
+            if self.snapshot_every and self._good_since_snap >= self.snapshot_every:
+                self.take_snapshot(driver.state)
+            return False
+        n = int(round(bad))
+        self.bad_steps += n
+        FaultCounters.inc("bad_steps", n)
+        print_distributed(
+            self.verbosity,
+            f"StepGuard: skipped {n} non-finite step(s) "
+            f"(streak {self.consecutive + bad:.0f}/{self.max_bad_steps})",
+        )
+        self.consecutive += bad
+        if self.consecutive >= self.max_bad_steps:
+            self.rollback(driver)
+            return True
+        return False
+
+    def rollback(self, driver) -> None:
+        """Restore the retained last-good state (a fresh copy — the snapshot
+        itself survives for the next rollback) and back off the LR."""
+        if self._snap is not None:
+            driver.state = _copy_state(self._snap)
+        if self.lr_backoff and self.lr_backoff != 1.0:
+            lr = get_learning_rate(driver.state.opt_state)
+            if lr is not None:
+                new_lr = max(lr * float(self.lr_backoff), self.min_lr)
+                if new_lr < lr:
+                    driver.state = driver.state.replace(
+                        opt_state=set_learning_rate(
+                            driver.state.opt_state, new_lr
+                        )
+                    )
+                    print_distributed(
+                        self.verbosity,
+                        f"StepGuard: rollback LR backoff {lr} -> {new_lr}",
+                    )
+        self.rollbacks += 1
+        FaultCounters.inc("rollbacks")
+        self.consecutive = 0.0
+
+    @classmethod
+    def from_config(cls, cfg: Optional[dict], verbosity: int = 0):
+        """``Training.fault_tolerance`` block → StepGuard, or None when the
+        guard is disabled (absent block, or ``enabled`` false) — the default,
+        keeping the compiled step bit-identical to the unguarded build."""
+        if not cfg or not cfg.get("enabled"):
+            return None
+        return cls(
+            max_bad_steps=cfg.get("max_bad_steps", 3),
+            lr_backoff=cfg.get("lr_backoff", 0.5),
+            min_lr=cfg.get("min_lr", 1e-6),
+            snapshot_every=cfg.get("snapshot_every", 0),
+            verbosity=verbosity,
+        )

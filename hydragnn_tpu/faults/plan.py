@@ -1,0 +1,205 @@
+"""Deterministic fault injection — the drill half of the fault-tolerance layer
+(docs/FAULT_TOLERANCE.md).
+
+A :class:`FaultPlan` is a parsed, seeded description of WHICH faults fire and
+WHEN, consulted by the code that must survive them: the training driver's
+batch source (NaN batches, collation stalls, process kill), the device-feed
+transfer stage (transient transfer crashes), and loader construction (corrupt
+samples). Every failure mode the guards/retry/quarantine/supervisor machinery
+handles has a reproducible drill here — ``bench.py --faults`` and the tier-1
+fault suite (tests/test_faults.py) are built on it.
+
+Spec grammar (comma-separated entries, driven by ``HYDRAGNN_FAULTS`` or the
+``Training.faults`` config string)::
+
+    seed=7                     # seeds the corrupt-sample draw
+    nan_grad@5                 # NaN-fill the node features of fed batch 5
+    nan_grad@12-14             # ... of fed batches 12..14 (inclusive)
+    corrupt_sample:count=3     # NaN-corrupt 3 seeded dataset samples
+    corrupt_sample:frac=0.05   # ... or a fraction of the dataset
+    slow_collate:ms=40         # sleep 40 ms before yielding every batch
+    slow_collate@2:ms=40       # ... only before fed batch 2
+    transfer_crash@3           # transfer 3 raises a TRANSIENT error, once
+    kill@9                     # SIGKILL this process at fed batch 9
+
+Batch/transfer indices are cumulative over the plan's lifetime (one plan per
+TrainingDriver), counted on the pipeline's host/transfer threads in feed
+order — deterministic for a seeded loader. ``kill`` fires only in the first
+incarnation of a supervised run (``HYDRAGNN_RESTART_COUNT`` unset or 0), so a
+restart drill terminates instead of kill-looping forever.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Iterable, Optional, Set
+
+import numpy as np
+
+from .counters import FaultCounters
+
+ENV_VAR = "HYDRAGNN_FAULTS"
+RESTART_ENV_VAR = "HYDRAGNN_RESTART_COUNT"
+
+
+class InjectedFault(RuntimeError):
+    """Base class for exceptions raised by fault injection."""
+
+
+class InjectedTransientError(InjectedFault):
+    """Injected failure that SHOULD be survivable by a retry (the drill for
+    the device feed's transient-transfer backoff). ``transient = True`` is the
+    attribute the pipeline's retry predicate keys off, so the drill exercises
+    exactly the production classification path."""
+
+    transient = True
+
+
+def _parse_steps(sel: str) -> Set[int]:
+    """``"5"`` → {5}; ``"12-14"`` → {12, 13, 14}."""
+    if "-" in sel:
+        lo, hi = sel.split("-", 1)
+        return set(range(int(lo), int(hi) + 1))
+    return {int(sel)}
+
+
+class FaultPlan:
+    """Parsed fault schedule with the hooks instrumented code consults."""
+
+    KINDS = ("nan_grad", "corrupt_sample", "slow_collate", "transfer_crash", "kill")
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec or ""
+        self.seed = 0
+        self.restart = int(os.environ.get(RESTART_ENV_VAR, "0") or 0)
+        self._nan_steps: Set[int] = set()
+        self._kill_steps: Set[int] = set()
+        self._slow: list = []  # (steps | None meaning every batch, seconds)
+        self._transfer_crashes: Set[int] = set()
+        self.corrupt_count = 0
+        self.corrupt_frac = 0.0
+        self._batch_i = 0
+        self._transfer_i = 0
+        self._lock = threading.Lock()
+        for raw in filter(None, (p.strip() for p in self.spec.split(","))):
+            self._parse_entry(raw)
+
+    def _parse_entry(self, raw: str) -> None:
+        if raw.startswith("seed="):
+            self.seed = int(raw.split("=", 1)[1])
+            return
+        head, *params = raw.split(":")
+        kind, _, sel = head.partition("@")
+        if kind not in self.KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {raw!r} "
+                f"(known: {', '.join(self.KINDS)})"
+            )
+        kv = {}
+        for p in params:
+            k, _, v = p.partition("=")
+            kv[k] = v
+        if kind == "nan_grad":
+            self._nan_steps |= _parse_steps(sel)
+        elif kind == "kill":
+            self._kill_steps |= _parse_steps(sel)
+        elif kind == "transfer_crash":
+            self._transfer_crashes |= _parse_steps(sel)
+        elif kind == "slow_collate":
+            seconds = float(kv.get("ms", "20")) / 1000.0
+            self._slow.append((_parse_steps(sel) if sel else None, seconds))
+        elif kind == "corrupt_sample":
+            if "count" in kv:
+                self.corrupt_count = int(kv["count"])
+            if "frac" in kv:
+                self.corrupt_frac = float(kv["frac"])
+
+    # ------------------------------------------------------------ constructors
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get(ENV_VAR, "").strip()
+        return cls(spec) if spec else None
+
+    @property
+    def active(self) -> bool:
+        return bool(
+            self._nan_steps
+            or self._kill_steps
+            or self._slow
+            or self._transfer_crashes
+            or self.corrupt_count
+            or self.corrupt_frac
+        )
+
+    # ------------------------------------------------------- batch-source hook
+    def wrap_batches(self, iterable: Iterable):
+        """Wrap the driver's host batch source (runs on the pipeline's host
+        thread): applies slow-collate stalls, process kill, and NaN-batch
+        corruption at the scheduled fed-batch indices."""
+        for batch in iterable:
+            i = self._batch_i
+            self._batch_i += 1
+            for steps, seconds in self._slow:
+                if steps is None or i in steps:
+                    FaultCounters.inc("injected_slow_collate")
+                    time.sleep(seconds)
+            if i in self._kill_steps and self.restart == 0:
+                FaultCounters.inc("injected_kill")
+                os.kill(os.getpid(), signal.SIGKILL)
+            if i in self._nan_steps:
+                FaultCounters.inc("injected_nan_batches")
+                batch = batch.replace(
+                    node_features=np.full_like(batch.node_features, np.nan)
+                )
+            yield batch
+
+    # --------------------------------------------------------- transfer hook
+    def on_transfer(self) -> None:
+        """Consulted once per transfer (pipeline transfer thread). Raises a
+        TRANSIENT error at scheduled transfer indices; each index fires only
+        once, so the retry that follows succeeds."""
+        with self._lock:
+            i = self._transfer_i
+            self._transfer_i += 1
+            fire = i in self._transfer_crashes
+            if fire:
+                self._transfer_crashes.discard(i)
+        if fire:
+            FaultCounters.inc("injected_transfer_crashes")
+            raise InjectedTransientError(
+                f"injected transient transfer failure at transfer {i}"
+            )
+
+    # ---------------------------------------------------------- sample hooks
+    def corrupt_sample_indices(self, n: int) -> Set[int]:
+        """Seeded choice of dataset indices to corrupt (empty when the plan
+        carries no corrupt_sample entry)."""
+        count = self.corrupt_count
+        if self.corrupt_frac:
+            count = max(count, int(round(self.corrupt_frac * n)))
+        count = min(count, n)
+        if count <= 0:
+            return set()
+        rng = np.random.default_rng(self.seed)
+        return set(int(i) for i in rng.choice(n, size=count, replace=False))
+
+    @staticmethod
+    def corrupt(sample):
+        """Corrupted copy of a GraphSample: NaN node features — the canonical
+        'unparseable/garbage record' stand-in the quarantine validator must
+        catch."""
+        bad = sample.clone()
+        bad.x = np.full_like(np.asarray(bad.x, dtype=np.float32), np.nan)
+        return bad
+
+    def corrupt_dataset(self, dataset: list) -> int:
+        """Corrupt the scheduled (seeded) samples IN PLACE; returns how many."""
+        idxs = self.corrupt_sample_indices(len(dataset))
+        for i in idxs:
+            dataset[i] = self.corrupt(dataset[i])
+        if idxs:
+            FaultCounters.inc("injected_corrupt_samples", len(idxs))
+        return len(idxs)

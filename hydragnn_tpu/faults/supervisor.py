@@ -1,0 +1,178 @@
+"""Crash-resume supervisor: restart-on-death around the periodic-checkpoint +
+``Training.resume`` contract (docs/FAULT_TOLERANCE.md).
+
+``run_training`` already resumes a killed run from its own periodic
+checkpoint — but only when an operator reruns it. This module makes that loop
+a first-class API::
+
+    hydragnn_tpu.run_training(config, supervise=True, max_restarts=3)
+    python -m hydragnn_tpu.faults.supervisor <config.json> [--max-restarts N]
+
+The supervisor forces ``Training.resume = 1`` (and a periodic checkpoint
+cadence if the config has none), snapshots the effective config into the run's
+log dir, then runs the training as a CHILD PROCESS so any death — SIGKILL,
+OOM, a segfaulting extension, an injected ``kill@K`` drill — is observable as
+a nonzero/negative returncode rather than taking the supervisor down with it.
+Each child gets ``HYDRAGNN_RESTART_COUNT`` in its environment (incarnation
+index — fault plans use it to fire process-kill drills only once), and every
+attempt is recorded in an atomically-updated ``logs/<name>/supervisor.json``
+(restart counts, returncodes, durations) — the restart metadata the tests and
+``bench.py --faults`` assert on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+import subprocess
+import time
+from typing import Optional
+
+from .counters import FaultCounters
+from .plan import RESTART_ENV_VAR
+
+SUPERVISOR_META = "supervisor.json"
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+
+
+def _prepare_config(config: dict) -> dict:
+    """Supervised copy of the config: resume from this run's own checkpoint on
+    every restart, and guarantee there IS a checkpoint to resume from."""
+    cfg = copy.deepcopy(config)
+    tr = cfg["NeuralNetwork"]["Training"]
+    tr["resume"] = 1
+    if not tr.get("periodic_checkpoint_every"):
+        tr["periodic_checkpoint_every"] = 1
+    return cfg
+
+
+def read_supervisor_meta(log_name: str, path: str = "./logs/") -> dict:
+    """The restart metadata of a supervised run ({} when none exists)."""
+    meta_path = os.path.join(path, log_name, SUPERVISOR_META)
+    if not os.path.exists(meta_path):
+        return {}
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+def run_supervised(
+    config,
+    max_restarts: int = 3,
+    logs_path: str = "./logs/",
+    python: Optional[str] = None,
+    extra_env: Optional[dict] = None,
+) -> dict:
+    """Run ``run_training(config)`` under a restart loop; returns the restart
+    metadata dict (also persisted as ``logs/<name>/supervisor.json``).
+
+    A child exiting 0 completes the run. Any other exit (including death by
+    signal) consumes one restart; the next child resumes from the run's last
+    periodic checkpoint. Exhausting ``max_restarts`` raises, with the full
+    attempt log in the metadata file.
+    """
+    from ..utils.config_utils import get_log_name_config
+    from ..utils.model import cleanup_stale_checkpoint_tmp
+
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    cfg = _prepare_config(config)
+    log_name = get_log_name_config(cfg)
+    run_dir = os.path.join(logs_path, log_name)
+    os.makedirs(run_dir, exist_ok=True)
+    # A previous incarnation may have died mid-checkpoint-replace.
+    cleanup_stale_checkpoint_tmp(run_dir)
+    cfg_path = os.path.join(run_dir, "supervisor_config.json")
+    _atomic_write_json(cfg_path, cfg)
+
+    meta = {
+        "log_name": log_name,
+        "max_restarts": int(max_restarts),
+        "restarts": 0,
+        "completed": False,
+        "attempts": [],
+    }
+    meta_path = os.path.join(run_dir, SUPERVISOR_META)
+    # Children import hydragnn_tpu by module path regardless of the run's
+    # cwd (training runs chdir'd into scratch dirs are the norm in tests).
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    attempt = 0
+    while True:
+        env = dict(os.environ)
+        env[RESTART_ENV_VAR] = str(attempt)
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        if extra_env:
+            env.update(extra_env)
+        t0 = time.time()
+        proc = subprocess.run(
+            [
+                python or sys.executable,
+                "-m",
+                "hydragnn_tpu.faults.supervisor",
+                "--child",
+                cfg_path,
+            ],
+            env=env,
+        )
+        meta["attempts"].append(
+            {
+                "attempt": attempt,
+                "returncode": proc.returncode,
+                "duration_s": round(time.time() - t0, 3),
+                "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            }
+        )
+        if proc.returncode == 0:
+            meta["completed"] = True
+            _atomic_write_json(meta_path, meta)
+            return meta
+        if attempt >= max_restarts:
+            _atomic_write_json(meta_path, meta)
+            raise RuntimeError(
+                f"supervised training failed after {attempt} restart(s) "
+                f"(max_restarts={max_restarts}); attempt log: {meta_path}"
+            )
+        attempt += 1
+        meta["restarts"] = attempt
+        FaultCounters.inc("restarts")
+        _atomic_write_json(meta_path, meta)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.faults.supervisor",
+        description="Crash-resume supervisor for hydragnn_tpu training runs.",
+    )
+    ap.add_argument("config", help="training config JSON path")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument(
+        "--child",
+        action="store_true",
+        help="internal: run one training incarnation in THIS process",
+    )
+    args = ap.parse_args(argv)
+    if args.child:
+        import hydragnn_tpu
+
+        hydragnn_tpu.run_training(args.config)
+        return 0
+    meta = run_supervised(args.config, max_restarts=args.max_restarts)
+    print(json.dumps(meta))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

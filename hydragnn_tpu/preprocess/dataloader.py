@@ -26,6 +26,53 @@ from ..graphs.collate import GraphArena, compute_pad_sizes
 from ..graphs.sample import GraphSample
 
 
+def invalid_sample_reason(s: GraphSample) -> Optional[str]:
+    """Why a sample must not reach collation (None = valid). The quarantine
+    validator (docs/FAULT_TOLERANCE.md): catches corrupt/unparseable records
+    — non-finite features, out-of-range edge indices, inconsistent packed
+    targets — BEFORE they poison a whole padded batch (one bad sample
+    otherwise NaNs the loss of every batch-mate, or crashes the collator
+    mid-epoch).
+
+    The serving admission check (serve/engine.py:InferenceEngine._validate)
+    overlaps on the structural edge/x checks but is a different contract —
+    request-facing, model-width-aware, no y/y_loc or finiteness (non-finite
+    OUTPUTS are guarded there instead); a change to either's shared
+    structural checks should be mirrored in the other."""
+    x = s.x
+    if x is None or np.ndim(x) != 2:
+        return "x is not a [num_nodes, F] array"
+    if not np.isfinite(np.asarray(x, dtype=np.float64)).all():
+        return "non-finite node features"
+    if s.pos is not None and not np.isfinite(
+        np.asarray(s.pos, dtype=np.float64)
+    ).all():
+        return "non-finite node positions"
+    n = int(np.shape(x)[0])
+    if s.edge_index is not None:
+        ei = np.asarray(s.edge_index)
+        if ei.ndim != 2 or ei.shape[0] != 2:
+            return "edge_index is not [2, num_edges]"
+        if ei.size and (ei.min() < 0 or ei.max() >= n):
+            return "edge_index references nodes outside the graph"
+        if s.edge_attr is not None and np.shape(s.edge_attr)[0] != ei.shape[1]:
+            return "edge_attr row count does not match num_edges"
+    if s.edge_attr is not None and not np.isfinite(
+        np.asarray(s.edge_attr, dtype=np.float64)
+    ).all():
+        return "non-finite edge attributes"
+    if (s.y is None) != (s.y_loc is None):
+        return "y and y_loc must be present together"
+    if s.y is not None:
+        y = np.asarray(s.y).reshape(-1)
+        if not np.isfinite(y.astype(np.float64)).all():
+            return "non-finite targets"
+        y_loc = np.asarray(s.y_loc).reshape(-1)
+        if y_loc.size < 2 or (np.diff(y_loc) < 0).any() or y_loc[-1] > y.size:
+            return "y_loc offsets are not a valid prefix of y"
+    return None
+
+
 class GraphDataLoader:
     def __init__(
         self,
@@ -40,6 +87,8 @@ class GraphDataLoader:
         edge_dim: Optional[int] = None,
         num_buckets: int = 1,
         reshuffle: str = "sample",
+        skip_budget: int = 0,
+        fault_plan=None,
     ):
         """``reshuffle`` picks the per-epoch shuffling granularity:
 
@@ -54,12 +103,25 @@ class GraphDataLoader:
           when the device link is slow (the tunneled-TPU bucketed path) or
           the host is collation-bound. A mild SGD semantics change, which is
           why it is opt-in (``Training.reshuffle`` in the JSON config).
+
+        ``skip_budget > 0`` enables the corrupt-sample quarantine
+        (docs/FAULT_TOLERANCE.md): samples failing ``invalid_sample_reason``
+        are dropped into ``self.quarantined`` (index + reason) up to the
+        budget; exceeding it fails loudly WITH the quarantine log. The
+        default 0 performs no validation at all — identical to the
+        historical loader. ``fault_plan`` (default: HYDRAGNN_FAULTS env)
+        injects seeded sample corruption for the drills.
         """
         if reshuffle not in ("sample", "batch"):
             raise ValueError(
                 f"reshuffle must be 'sample' or 'batch', got {reshuffle!r}"
             )
         self.dataset = list(dataset)
+        self.skip_budget = int(skip_budget)
+        self.quarantined: List[tuple] = []
+        self._apply_fault_plan(fault_plan)
+        if self.skip_budget > 0:
+            self._quarantine_invalid_samples()
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
@@ -90,6 +152,44 @@ class GraphDataLoader:
         ) * (1 << 20)
         self._cache_bytes = 0
         self._build_buckets(max(1, int(num_buckets)))
+
+    def _apply_fault_plan(self, fault_plan) -> None:
+        """Seeded corrupt-sample injection (the quarantine drill). Runs
+        BEFORE validation so the loader both injects and catches its own
+        drill corruption in one construction."""
+        from ..faults.plan import FaultPlan
+
+        plan = fault_plan if fault_plan is not None else FaultPlan.from_env()
+        if plan is not None and (plan.corrupt_count or plan.corrupt_frac):
+            plan.corrupt_dataset(self.dataset)
+
+    def _quarantine_invalid_samples(self) -> None:
+        """Drop invalid samples (bounded by ``skip_budget``) before buckets
+        and pad shapes are computed, so the surviving dataset is exactly what
+        every later stage sees. Exceeding the budget raises with the log —
+        a dataset that corrupt can only be fixed upstream, and silently
+        training on its remainder would misreport coverage."""
+        from ..faults.counters import FaultCounters
+
+        kept = []
+        for i, s in enumerate(self.dataset):
+            reason = invalid_sample_reason(s)
+            if reason is None:
+                kept.append(s)
+            else:
+                self.quarantined.append((i, reason))
+        if len(self.quarantined) > self.skip_budget:
+            log = "; ".join(
+                f"sample {i}: {r}" for i, r in self.quarantined[:10]
+            )
+            raise RuntimeError(
+                f"quarantine budget exceeded: {len(self.quarantined)} corrupt "
+                f"samples > skip_budget={self.skip_budget} — {log}"
+                + (" ..." if len(self.quarantined) > 10 else "")
+            )
+        if self.quarantined:
+            FaultCounters.inc("quarantined_samples", len(self.quarantined))
+            self.dataset = kept
 
     def _build_buckets(self, num_buckets: int) -> None:
         """Partition dataset indices into node-count quantile buckets, each

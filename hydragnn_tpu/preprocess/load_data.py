@@ -26,6 +26,18 @@ def dataset_loading_and_splitting(config: Dict):
     if "total" in config["Dataset"]["path"].keys():
         total_to_train_val_test_pkls(config)
     trainset, valset, testset = load_train_val_test_sets(config)
+    # Config-driven fault drills (Training.faults) must reach the LOADERS
+    # too — corrupt_sample injection happens at loader construction, and the
+    # loaders only consult the HYDRAGNN_FAULTS env on their own. Env wins
+    # when both are set (same precedence as run_training's driver plan).
+    import os as _os
+
+    from ..faults.plan import FaultPlan
+
+    fault_plan = None
+    spec = config["NeuralNetwork"]["Training"].get("faults")
+    if spec and not _os.environ.get("HYDRAGNN_FAULTS"):
+        fault_plan = FaultPlan(spec)
     return create_dataloaders(
         trainset,
         valset,
@@ -33,11 +45,15 @@ def dataset_loading_and_splitting(config: Dict):
         batch_size=config["NeuralNetwork"]["Training"]["batch_size"],
         num_buckets=config["Dataset"].get("num_buckets", 1),
         reshuffle=config["NeuralNetwork"]["Training"].get("reshuffle", "sample"),
+        # Corrupt-sample quarantine budget (docs/FAULT_TOLERANCE.md); 0 =
+        # no validation, the historical behavior.
+        skip_budget=config["Dataset"].get("skip_budget", 0),
+        fault_plan=fault_plan,
     )
 
 
 def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1,
-                       reshuffle="sample"):
+                       reshuffle="sample", skip_budget=0, fault_plan=None):
     """Three GraphDataLoaders; multi-process runs shard every split by process
     (the DistributedSampler analog). Returns (train, val, test, sampler_list) for
     reference API parity — the loaders are their own samplers here.
@@ -79,6 +95,8 @@ def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1,
                 # freezes membership so collation + device transfer cache
                 # across epochs (train loader only — eval never shuffles).
                 reshuffle=reshuffle if shuffle else "sample",
+                skip_budget=skip_budget,
+                fault_plan=fault_plan,
             )
         )
     train_loader, val_loader, test_loader = loaders

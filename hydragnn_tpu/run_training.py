@@ -32,19 +32,36 @@ from .utils.time_utils import print_timers
 
 
 @singledispatch
-def run_training(config, mesh=None):
+def run_training(config, mesh=None, supervise=False, max_restarts=3):
     raise TypeError("Input must be filename string or configuration dictionary.")
 
 
 @run_training.register
-def _(config_file: str, mesh=None):
+def _(config_file: str, mesh=None, supervise=False, max_restarts=3):
     with open(config_file, "r") as f:
         config = json.load(f)
-    return run_training(config, mesh=mesh)
+    return run_training(
+        config, mesh=mesh, supervise=supervise, max_restarts=max_restarts
+    )
 
 
 @run_training.register
-def _(config: dict, mesh=None):
+def _(config: dict, mesh=None, supervise=False, max_restarts=3):
+    if supervise:
+        # Crash-resume supervisor (docs/FAULT_TOLERANCE.md): the training run
+        # happens in child processes under a restart loop around the periodic
+        # checkpoint + Training.resume contract. Returns the restart metadata
+        # (also persisted at logs/<name>/supervisor.json), not the history —
+        # the epoch history lives in the run's checkpoint meta.
+        if mesh is not None:
+            raise ValueError(
+                "run_training(supervise=True) spawns child processes and "
+                "cannot adopt an in-process mesh; configure the mesh via "
+                "Training.graph_axis / multi-process launch instead"
+            )
+        from .faults.supervisor import run_supervised
+
+        return run_supervised(config, max_restarts=max_restarts)
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
 
     # Bootstrap BEFORE anything touches jax (setup_log rank-prefixes via
@@ -96,6 +113,12 @@ def _(config: dict, mesh=None):
     writer = get_summary_writer(log_name)
     barrier("logdir")
     os.makedirs("./logs/" + log_name, exist_ok=True)
+    if world_rank == 0:
+        # Startup cleanup: *.tmp litter from a crash mid-checkpoint-replace
+        # in a previous incarnation (supervised restarts land here).
+        from .utils.model import cleanup_stale_checkpoint_tmp
+
+        cleanup_stale_checkpoint_tmp("./logs/" + log_name)
     with open("./logs/" + log_name + "/config.json", "w") as f:
         json.dump(config, f)
 
@@ -161,8 +184,24 @@ def _(config: dict, mesh=None):
     profiler = Profiler("./logs/" + log_name)
     profiler.setup(config.get("Profile"))
 
+    # Fault tolerance (docs/FAULT_TOLERANCE.md): the non-finite step guard is
+    # opt-in via the Training.fault_tolerance block (disabled = compiled
+    # steps identical to the unguarded build); fault DRILLS come from the
+    # HYDRAGNN_FAULTS env or the Training.faults spec string.
+    training_cfg = config["NeuralNetwork"]["Training"]
+    fault_plan = None
+    if training_cfg.get("faults") and not os.environ.get("HYDRAGNN_FAULTS"):
+        from .faults import FaultPlan
+
+        fault_plan = FaultPlan(training_cfg["faults"])
     driver = TrainingDriver(
-        model, optimizer, state, mesh=mesh, verbosity=verbosity
+        model,
+        optimizer,
+        state,
+        mesh=mesh,
+        verbosity=verbosity,
+        fault_tolerance=training_cfg.get("fault_tolerance"),
+        fault_plan=fault_plan,
     )
 
     # Visualizer gets the test set's input node features and graph sizes
@@ -205,6 +244,9 @@ def _(config: dict, mesh=None):
         checkpoint_every=config["NeuralNetwork"]["Training"].get(
             "periodic_checkpoint_every", 0
         ),
+        checkpoint_keep_last_k=config["NeuralNetwork"]["Training"].get(
+            "checkpoint_keep_last_k", 0
+        ),
         start_epoch=start_epoch,
         history=prior_history,
     )
@@ -238,6 +280,9 @@ def _(config: dict, mesh=None):
             "scheduler": scheduler.state_dict(),
             "history": history,
         },
+        keep_last_k=config["NeuralNetwork"]["Training"].get(
+            "checkpoint_keep_last_k", 0
+        ),
     )
     # Non-zero ranks must not race ahead into a checkpoint load (e.g.
     # run_prediction immediately after training) while rank 0 is still writing.

@@ -11,6 +11,7 @@ from .engine import (
     EngineClosedError,
     EngineFailedError,
     InferenceEngine,
+    NonFiniteOutputError,
 )
 from .metrics import LatencyHistogram, ServeMetrics
 from .server import InferenceServer, parse_graph
@@ -22,6 +23,7 @@ __all__ = [
     "InferenceEngine",
     "InferenceServer",
     "LatencyHistogram",
+    "NonFiniteOutputError",
     "ServeMetrics",
     "parse_graph",
 ]

@@ -55,6 +55,20 @@ def build_parser() -> argparse.ArgumentParser:
         "compiled at startup unless --no-warmup",
     )
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument(
+        "--max-worker-restarts",
+        type=int,
+        default=1,
+        help="fatal worker errors tolerated by restarting the pipeline "
+        "(degraded, keeps serving) before the engine poisons; 0 = poison "
+        "on the first (docs/FAULT_TOLERANCE.md)",
+    )
+    ap.add_argument(
+        "--no-output-guard",
+        action="store_true",
+        help="disable the non-finite output guard (NaN outputs then return "
+        "as 200s instead of failing the request)",
+    )
     ap.add_argument("--verbose", action="store_true")
     return ap
 
@@ -71,6 +85,8 @@ def main(argv=None) -> int:
         queue_limit=args.queue_limit,
         bucket_ladder=ladder,
         warmup=not args.no_warmup,
+        max_worker_restarts=args.max_worker_restarts,
+        guard_outputs=not args.no_output_guard,
     )
     server = InferenceServer(
         engine, host=args.host, port=args.port, verbose=args.verbose

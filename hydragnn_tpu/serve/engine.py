@@ -73,6 +73,12 @@ class EngineFailedError(RuntimeError):
     """A worker thread died; the original exception is ``__cause__``."""
 
 
+class NonFiniteOutputError(RuntimeError):
+    """The model produced NaN/Inf for this request — the request fails, the
+    engine keeps serving (the serving analog of the training step guard,
+    docs/FAULT_TOLERANCE.md)."""
+
+
 class _Future:
     """Minimal thread-safe future.
 
@@ -159,6 +165,16 @@ class InferenceEngine:
         Optional per-head names and min-max pairs; with ``y_minmax`` set,
         outputs are denormalized (``v * (ymax - ymin) + ymin``, the
         postprocess.output_denormalize arithmetic) before futures resolve.
+    guard_outputs:
+        Check every resolved output for NaN/Inf on the host; a
+        non-finite output fails THAT request with
+        :class:`NonFiniteOutputError` instead of returning garbage with a
+        200 (the serving reuse of the training non-finite guard).
+    max_worker_restarts:
+        Fatal worker errors within this budget RESTART the pipeline threads
+        (pending/queued requests fail, the engine goes ``degraded`` but keeps
+        accepting traffic) instead of poisoning the engine. 0 = the
+        historical binary poisoning.
     autostart:
         Tests set False to exercise queue behavior without worker threads;
         call :meth:`start` to launch them later.
@@ -177,6 +193,8 @@ class InferenceEngine:
         head_names: Optional[Sequence[str]] = None,
         y_minmax: Optional[Sequence] = None,
         metrics: Optional[ServeMetrics] = None,
+        guard_outputs: bool = True,
+        max_worker_restarts: int = 0,
         autostart: bool = True,
     ):
         import jax
@@ -216,6 +234,13 @@ class InferenceEngine:
         self._error: Optional[BaseException] = None
         self._feed: Optional[DeviceFeed] = None
         self._dispatcher: Optional[threading.Thread] = None
+        self._guard_outputs = bool(guard_outputs)
+        self._restarts_left = int(max_worker_restarts)
+        self._degraded = False
+        # Per-incarnation stop flag for the batcher generator: on a worker
+        # restart the OLD batcher must stop consuming the shared request
+        # queue before the new one starts (two live batchers would race).
+        self._gen_stop: Optional[threading.Event] = None
 
         if warmup and self._ladder:
             self.warmup()
@@ -227,8 +252,11 @@ class InferenceEngine:
         """Launch the batcher→transfer→dispatch pipeline (idempotent)."""
         if self._dispatcher is not None:
             return
+        self._gen_stop = threading.Event()
         self._feed = DeviceFeed(
-            self._batch_source(), transfer=self._transfer, host_depth=2
+            self._batch_source(self._gen_stop),
+            transfer=self._transfer,
+            host_depth=2,
         )
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="hydragnn-serve-dispatch",
@@ -244,6 +272,14 @@ class InferenceEngine:
             and self._error is None
             and not self._closing.is_set()
         )
+
+    @property
+    def degraded(self) -> bool:
+        """Sticky health downgrade: the engine is serving, but it has seen
+        batch-scoped failures, non-finite outputs, or a worker restart since
+        construction — surfaced in /healthz next to the counters so operators
+        see gray, not just green/black."""
+        return self._degraded
 
     def close(self, timeout: float = 10.0) -> None:
         """Drain in-flight batches, stop the threads, fail stragglers."""
@@ -365,6 +401,12 @@ class InferenceEngine:
         return [f.result(timeout) for f in futures]
 
     def _validate(self, sample: GraphSample) -> None:
+        # Overlaps structurally with the loader-side quarantine validator
+        # (preprocess/dataloader.py:invalid_sample_reason) but is a distinct
+        # contract: request-facing errors, model input/edge width checks, no
+        # y/y_loc (requests are unlabeled) and no finiteness (non-finite
+        # OUTPUTS fail per-request in _resolve). Mirror changes to the
+        # shared structural checks there.
         x = sample.x
         if x is None or np.ndim(x) != 2:
             raise ValueError("sample.x must be a [num_nodes, F] array")
@@ -420,15 +462,17 @@ class InferenceEngine:
         return max(0.05, batches_queued * max(per_batch, 1e-3))
 
     # ----------------------------------------------------------- the worker
-    def _batch_source(self):
+    def _batch_source(self, stop: threading.Event):
         """Micro-batcher generator (runs on the DeviceFeed host thread):
-        pop → deadline/size flush → arena collation → host batch."""
+        pop → deadline/size flush → arena collation → host batch. ``stop`` is
+        this incarnation's kill switch — set by a worker restart so a stale
+        batcher cannot keep consuming the shared queue."""
         q = self._queue
         while True:
             try:
                 first = q.get(timeout=0.05)
             except queue.Empty:
-                if self._closing.is_set():
+                if self._closing.is_set() or stop.is_set():
                     return
                 continue
             if first is _SHUTDOWN:
@@ -457,6 +501,8 @@ class InferenceEngine:
                 for req in entries:
                     self._reject(req, e)
                 self.metrics.count("errors_total")
+                self.metrics.count("bad_batches_total")
+                self._degraded = True
                 work = None
             if work is not None:
                 yield work
@@ -560,12 +606,25 @@ class InferenceEngine:
             # The batcher's shutdown marker ends the feed iteration; every
             # batch flushed before it is still executed and resolved here.
             for work, dev_batch in self._feed:
-                self._resolve(work, self._execute(dev_batch))
+                # _execute failures (compile, device runtime) fall through to
+                # _fail: the device's health is engine-scoped. Resolution
+                # failures (per-request slicing/denormalization) are
+                # BATCH-scoped: fail this batch's futures, keep serving.
+                outputs = self._execute(dev_batch)
+                try:
+                    self._resolve(work, outputs)
+                except Exception as e:  # noqa: BLE001 — batch-scoped
+                    for req in work.requests:
+                        self._reject(req, e)
+                    self.metrics.count("errors_total")
+                    self.metrics.count("bad_batches_total")
+                    self._degraded = True
         except BaseException as e:  # noqa: BLE001 — re-raised at callers
             self._fail(e)
 
     def _resolve(self, work: _BatchWork, outputs: List[np.ndarray]) -> None:
         now = time.perf_counter()
+        batch_had_nonfinite = False
         for i, req in enumerate(work.requests):
             per_head: List[np.ndarray] = []
             for ihead, htype in enumerate(self.model.output_type):
@@ -576,10 +635,27 @@ class InferenceEngine:
                     start = int(work.node_start[i])
                     val = out[start : start + req.sample.num_nodes]
                 per_head.append(self._denormalize(ihead, val))
+            if self._guard_outputs and any(
+                not np.isfinite(v).all() for v in per_head
+            ):
+                # The serving reuse of the non-finite guard: THIS request
+                # fails; batch-mates and the engine are unaffected.
+                self.metrics.count("nonfinite_total")
+                batch_had_nonfinite = True
+                self._reject(
+                    req,
+                    NonFiniteOutputError(
+                        "model produced non-finite outputs for this request"
+                    ),
+                )
+                continue
             with self._lock:
                 self._pending.discard(req.future)
             req.future.set_result(per_head)
             self.metrics.observe("e2e", now - req.t_submit)
+        if batch_had_nonfinite:
+            self.metrics.count("bad_batches_total")
+            self._degraded = True
 
     def _denormalize(self, ihead: int, value: np.ndarray) -> np.ndarray:
         if self._y_minmax is None:
@@ -600,18 +676,36 @@ class InferenceEngine:
             fut.set_exception(exc)
 
     def _fail(self, exc: BaseException) -> None:
-        """A worker thread died: poison the engine and fail every pending
-        future so no caller blocks forever (the 'never wedge the queue'
-        contract)."""
+        """A worker thread died. Within the ``max_worker_restarts`` budget:
+        fail the in-flight/queued requests (their work is unrecoverable),
+        mark the engine degraded, and RESTART the pipeline threads — the
+        engine keeps serving. Budget exhausted (or 0, the default): poison
+        the engine and fail every pending future so no caller blocks forever
+        (the 'never wedge the queue' contract)."""
         if isinstance(exc, EngineClosedError) or (
             self._closing.is_set() and self._error is None
         ):
             self._fail_pending(EngineClosedError("engine closed"))
             return
-        self._error = exc
         self.metrics.count("errors_total")
-        self._closing.set()
-        # Drain queued requests that never reached a batch.
+        restartable = self._restarts_left > 0 and not self._closing.is_set()
+        if not restartable:
+            # Poison FIRST so concurrent submits fail fast (their post-
+            # enqueue re-check sees the error) before the queue drain below.
+            self._error = exc
+            self._closing.set()
+        # Tear down this incarnation's pipeline either way: stop the batcher
+        # FIRST (a stale batcher racing a successor on the shared queue would
+        # strand whatever it popped), then cancel + join the feed threads.
+        if self._gen_stop is not None:
+            self._gen_stop.set()
+        if self._feed is not None:
+            self._feed.close()
+            self._feed.join(2.0)
+        # Drain queued requests that never reached a batch. (A request
+        # admitted during this window may be failed here yet still sit in
+        # the queue; the successor batcher then computes it and its
+        # set_result is a benign no-op over the already-failed future.)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -620,8 +714,13 @@ class InferenceEngine:
             if req is not _SHUTDOWN:
                 self._reject(req, exc)
         self._fail_pending(exc)
-        if self._feed is not None:
-            self._feed.close()
+        if restartable:
+            self._restarts_left -= 1
+            self._degraded = True
+            self.metrics.count("engine_restarts_total")
+            self._feed = None
+            self._dispatcher = None
+            self.start()
 
     # -------------------------------------------------------------- warmup
     def warmup(self, ladder: Optional[Sequence[Tuple[int, int]]] = None) -> int:

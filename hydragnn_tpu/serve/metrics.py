@@ -124,6 +124,13 @@ class ServeMetrics:
         self.requests_total = 0
         self.rejected_total = 0
         self.errors_total = 0
+        # Fault-tolerance split of errors (docs/FAULT_TOLERANCE.md):
+        # batch-scoped failures keep the engine serving; worker restarts
+        # consume the engine's restart budget; non-finite outputs fail the
+        # REQUEST, not the engine.
+        self.bad_batches_total = 0
+        self.nonfinite_total = 0
+        self.engine_restarts_total = 0
         self.batches_total = 0
         self.graphs_total = 0
         self.cache_hits_total = 0
@@ -175,6 +182,9 @@ class ServeMetrics:
                 "requests_total": self.requests_total,
                 "rejected_total": self.rejected_total,
                 "errors_total": self.errors_total,
+                "bad_batches_total": self.bad_batches_total,
+                "nonfinite_total": self.nonfinite_total,
+                "engine_restarts_total": self.engine_restarts_total,
                 "batches_total": batches,
                 "graphs_total": self.graphs_total,
                 "bucket_cache": {
@@ -215,6 +225,12 @@ class ServeMetrics:
             f"{p}_rejected_total {self.rejected_total}",
             f"# TYPE {p}_errors_total counter",
             f"{p}_errors_total {self.errors_total}",
+            f"# TYPE {p}_bad_batches_total counter",
+            f"{p}_bad_batches_total {self.bad_batches_total}",
+            f"# TYPE {p}_nonfinite_total counter",
+            f"{p}_nonfinite_total {self.nonfinite_total}",
+            f"# TYPE {p}_engine_restarts_total counter",
+            f"{p}_engine_restarts_total {self.engine_restarts_total}",
             f"# TYPE {p}_batches_total counter",
             f"{p}_batches_total {self.batches_total}",
             f"# TYPE {p}_graphs_total counter",

@@ -91,13 +91,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path == "/healthz":
             engine = self.engine
+            # Three health states instead of the old binary: ok (200),
+            # degraded-but-serving (200, degraded: true — bad batches,
+            # non-finite outputs, or a worker restart happened), down (503).
             self._send_json(
                 200 if engine.running else 503,
                 {
                     "ok": engine.running,
+                    "degraded": engine.degraded,
                     "queue_depth": engine._queue.qsize(),
                     "queue_limit": engine.queue_limit,
                     "compiled_buckets": len(engine._executables),
+                    "bad_batches": engine.metrics.bad_batches_total,
+                    "nonfinite_outputs": engine.metrics.nonfinite_total,
+                    "restarts": engine.metrics.engine_restarts_total,
                 },
             )
         elif self.path == "/metrics":

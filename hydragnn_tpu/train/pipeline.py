@@ -39,6 +39,58 @@ import time
 from typing import Callable, Iterable, Optional
 
 
+def transfer_error_is_transient(e: BaseException) -> bool:
+    """Transfer failures worth retrying: runtime transport flaps (the tunnel's
+    UNAVAILABLE / connection-refused RPC errors, transient allocator
+    exhaustion) and anything explicitly marked ``transient`` (the fault
+    layer's injected drill errors). Programming errors — shape/dtype
+    mismatches, cancelled pipelines — are NOT transient and propagate on the
+    first raise."""
+    if getattr(e, "transient", False):
+        return True
+    msg = f"{type(e).__name__}: {e}"
+    return (
+        "UNAVAILABLE" in msg
+        or "Connection refused" in msg
+        or "RESOURCE_EXHAUSTED" in msg
+        or "Socket closed" in msg
+    )
+
+
+def with_transfer_retries(
+    transfer: Callable,
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    max_backoff_s: float = 2.0,
+    transient: Callable = transfer_error_is_transient,
+) -> Callable:
+    """Wrap a transfer callable with capped exponential backoff on TRANSIENT
+    failures (docs/FAULT_TOLERANCE.md). Runs on the pipeline's transfer
+    thread, so the backoff sleep never stalls device compute — the device
+    queue simply drains one slot deeper. Retries are counted
+    (FaultCounters ``transfer_retries``); a non-transient error, or a
+    transient one that survives every attempt, propagates to the consumer
+    exactly like before."""
+    if retries <= 0:
+        return transfer
+
+    def retrying(item):
+        delay = backoff_s
+        for attempt in range(retries + 1):
+            try:
+                return transfer(item)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if attempt >= retries or not transient(e):
+                    raise
+                from ..faults.counters import FaultCounters
+
+                FaultCounters.inc("transfer_retries")
+                time.sleep(min(delay, max_backoff_s))
+                delay *= 2.0
+
+    return retrying
+
+
 class _Prefetcher:
     """Background-thread batch producer: the stage boundary of the pipeline.
     Bounded queue; exceptions re-raised at the consumer; abandoning iteration
@@ -166,7 +218,11 @@ class DeviceFeed:
     Exceptions raised in either stage re-raise at the consumer; ``close()``
     (also triggered by abandoning iteration) cancels both threads, in
     downstream-first order so a transfer thread blocked on the host queue is
-    woken by the host stage's close."""
+    woken by the host stage's close.
+
+    Transient transfer failures (transfer_error_is_transient) are retried
+    with capped exponential backoff on the transfer thread before
+    propagating — ``transfer_retries=0`` restores fail-on-first-raise."""
 
     def __init__(
         self,
@@ -174,7 +230,13 @@ class DeviceFeed:
         transfer: Optional[Callable] = None,
         host_depth: int = 8,
         device_depth: int = 2,
+        transfer_retries: int = 2,
+        transfer_backoff_s: float = 0.05,
     ):
+        if transfer is not None and transfer_retries > 0:
+            transfer = with_transfer_retries(
+                transfer, retries=transfer_retries, backoff_s=transfer_backoff_s
+            )
         self._host = _Prefetcher(iterable, depth=host_depth)
         self._dev = (
             None

@@ -38,7 +38,10 @@ from .trainer import (
 
 
 class EpochMetrics:
-    """Graph-count-weighted averages accumulated over an epoch."""
+    """Graph-count-weighted averages accumulated over an epoch. The guarded
+    step's extra ``bad`` metric is consumed by StepGuard (per step/chunk) and
+    aggregated process-wide in FaultCounters, not here — bad steps carry zero
+    ``count`` weight so the averages are already skip-correct."""
 
     def __init__(self):
         self.loss = 0.0
@@ -68,7 +71,11 @@ class TrainingDriver:
         state: TrainState,
         mesh=None,
         verbosity: int = 0,
+        fault_tolerance: Optional[dict] = None,
+        fault_plan=None,
     ):
+        from ..faults import FaultPlan, StepGuard
+
         self.model = model
         self.optimizer = optimizer
         self.state = state
@@ -76,6 +83,15 @@ class TrainingDriver:
         self.verbosity = verbosity
         self.n_devices = 1
         self.multihost = jax.process_count() > 1
+        # Non-finite step guard (Training.fault_tolerance): None = disabled =
+        # the compiled steps are built WITHOUT the flag — bit-identical to
+        # the historical build. Fault injection (drills) is env/config-driven
+        # and independent of the guard.
+        self.guard = StepGuard.from_config(fault_tolerance, verbosity)
+        self.fault_plan = (
+            fault_plan if fault_plan is not None else FaultPlan.from_env()
+        )
+        guard = self.guard is not None
         if mesh is not None:
             # Each process stacks only its LOCAL slice of the data axis; the
             # stacked host-local array is lifted to a global jax.Array below —
@@ -86,13 +102,17 @@ class TrainingDriver:
                 else mesh.shape["data"]
             )
             donate = state_donation_safe(state)
-            self.train_step = make_train_step_dp(model, optimizer, mesh, donate)
+            self.train_step = make_train_step_dp(
+                model, optimizer, mesh, donate, guard=guard
+            )
             self.eval_step = make_eval_step_dp(model, mesh)
         else:
             donate = state_donation_safe(state)
-            self.train_step = make_train_step(model, optimizer, donate)
+            self.train_step = make_train_step(model, optimizer, donate, guard=guard)
             self.eval_step = make_eval_step(model)
-            self.epoch_scan = make_train_epoch_scan(model, optimizer, donate)
+            self.epoch_scan = make_train_epoch_scan(
+                model, optimizer, donate, guard=guard
+            )
         # Chunked lax.scan over the epoch: one device dispatch per chunk
         # instead of per batch (dispatch overhead dominates at HydraGNN's
         # model sizes). Chunk bounds the stacked batches' HBM footprint.
@@ -156,10 +176,24 @@ class TrainingDriver:
             self._sharding_trees[key] = cached
         return cached
 
+    def _wrap_faults(self, iterable):
+        """Route a host batch source through the fault plan's injection hooks
+        (NaN batches, collation stalls, process kill) — identity when no plan
+        is active. Sits on the pipeline's host thread, BEFORE chunk stacking
+        and transfer, on every train path."""
+        if self.fault_plan is None or not self.fault_plan.active:
+            return iterable
+        return self.fault_plan.wrap_batches(iterable)
+
     def _put_timed(self, payload, prof=None):
         """The transfer stage: ONE blocking device_put per payload, on the
         pipeline's transfer thread. Batch k+1 commits (DMA) while step k
-        computes; blocking here records true wire seconds, not dispatch."""
+        computes; blocking here records true wire seconds, not dispatch.
+        Transient failures (including the fault plan's injected transfer
+        crashes, consulted here) are retried by the DeviceFeed's backoff
+        wrapper around this function."""
+        if self.fault_plan is not None:
+            self.fault_plan.on_transfer()
         span = (
             prof.annotate("h2d") if prof is not None else contextlib.nullcontext()
         )
@@ -256,6 +290,10 @@ class TrainingDriver:
 
     def train_epoch(self, loader, profiler: Optional[Profiler] = None):
         self.feed_stats.reset()
+        if self.guard is not None:
+            # Epoch-start last-good snapshot: the rollback target (taken
+            # before the donating step can consume these buffers).
+            self.guard.begin_epoch(self)
         # Scan path only when nothing needs per-step host hooks.
         if self.mesh is None and not (profiler and profiler.active):
             return self._train_epoch_scan(loader)
@@ -265,7 +303,9 @@ class TrainingDriver:
         # (device_put with the step's placement) -> this consumer. Batch k+1
         # is committed device memory while step k executes.
         batches = DeviceFeed(
-            self._device_groups(loader) if self.mesh is not None else iter(loader),
+            self._device_groups(self._wrap_faults(loader))
+            if self.mesh is not None
+            else self._wrap_faults(iter(loader)),
             transfer=lambda b: self._put_timed(b, prof),
         )
         batch_iter = iter(iterate_tqdm(batches, self.verbosity))
@@ -286,6 +326,8 @@ class TrainingDriver:
                 ):
                     self.state, m = self.train_step(self.state, batch, self.rng)
                     metrics.update(m)
+                if self.guard is not None:
+                    self.guard.after_update(self, m)
                 if profiler:
                     profiler.step()
         finally:
@@ -337,6 +379,8 @@ class TrainingDriver:
                             self.state, payload, perm, self.rng
                         )
                     metrics.update(m)
+                if self.guard is not None:
+                    self.guard.after_update(self, m)
             self._credit_timers("train")
             return metrics.averages()
 
@@ -385,7 +429,7 @@ class TrainingDriver:
         ``(single, host payload)``. Runs on the pipeline's host thread, so
         numpy stacking also overlaps device compute."""
         bufs: dict = {}
-        for b in iterate_tqdm(loader, self.verbosity):
+        for b in self._wrap_faults(iterate_tqdm(loader, self.verbosity)):
             buf = bufs.setdefault(self._shape_key(b), [])
             buf.append(b)
             if len(buf) == self.scan_chunk:
@@ -414,6 +458,8 @@ class TrainingDriver:
             else:
                 self.state, m = self.epoch_scan(self.state, payload, self.rng)
             metrics.update(m)
+        if self.guard is not None:
+            self.guard.after_update(self, m)
         if sink is not None:
             nbytes = self._tree_nbytes(payload)
             if sink["bytes"] + nbytes <= self._cache_budget_bytes():
@@ -548,6 +594,7 @@ def train_validate_test(
     plot_hist_solution: bool = False,
     checkpoint_name: Optional[str] = None,
     checkpoint_every: int = 0,
+    checkpoint_keep_last_k: int = 0,
     start_epoch: int = 0,
     history: Optional[dict] = None,
 ):
@@ -648,6 +695,7 @@ def train_validate_test(
                     "scheduler": scheduler.state_dict() if scheduler else None,
                     "history": history,
                 },
+                keep_last_k=checkpoint_keep_last_k,
             )
     if profiler:
         profiler.stop()

@@ -120,9 +120,38 @@ def state_donation_safe(state: TrainState) -> bool:
     return True
 
 
-def _step_body(model: HydraGNN, optimizer):
+def _all_finite(loss, grads):
+    """ONE fused reduction: loss and every gradient leaf are finite. The
+    compiled step's non-finite guard flag (docs/FAULT_TOLERANCE.md)."""
+    ok = jnp.isfinite(loss)
+    for g in jax.tree_util.tree_leaves(grads):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def _keep_if(ok, new_tree, old_tree):
+    """Elementwise select: the new pytree on a finite step, the old one on a
+    bad step. Deliberately ``where`` and NOT ``lax.cond``: a conditional
+    region changes XLA's fusion boundaries and the clean path would no longer
+    be bit-identical to the unguarded build (measured on CPU), while
+    ``jnp.where(True, n, o)`` selects ``n`` exactly. The select pass costs a
+    state-sized read per step — noise next to fwd+bwd at production batch
+    sizes (guard_overhead_pct in FAULTS_rNN.json tracks it)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+    )
+
+
+def _step_body(model: HydraGNN, optimizer, guard: bool = False):
     """The single-device gradient step shared by make_train_step and the
-    scanned epoch (one definition — the two compiled paths must never drift)."""
+    scanned epoch (one definition — the two compiled paths must never drift).
+
+    With ``guard=True`` the step additionally computes an all-finite flag over
+    loss + grads and SKIPS the update on a non-finite step: params, opt_state,
+    and batch_stats keep their previous values, the step's metrics carry zero
+    weight, and ``metrics["bad"]`` reports the skip (summed per chunk on the
+    scan path) for the host-side StepGuard policy. guard=False emits exactly
+    the historical computation — the flag costs nothing when disabled."""
     from ..utils.optimizer import ValueFnTransformation
 
     needs_value_fn = isinstance(optimizer, ValueFnTransformation)
@@ -156,23 +185,42 @@ def _step_body(model: HydraGNN, optimizer):
         new_params = jax.tree_util.tree_map(
             lambda p, u: p + u, state.params, updates
         )
+        count = batch.count_real_graphs().astype(jnp.float32)
+        if guard:
+            ok = _all_finite(loss, grads)
+            new_params = _keep_if(ok, new_params, state.params)
+            new_opt = _keep_if(ok, new_opt, state.opt_state)
+            new_bstats = _keep_if(ok, new_bstats, state.batch_stats)
+            okf = ok.astype(jnp.float32)
+            count = count * okf
+            # Zero the VALUES before weighting: NaN * 0 is NaN, so a bad
+            # step's loss must be selected away, not merely zero-weighted.
+            metrics = {
+                "loss": jnp.where(ok, loss, 0.0) * count,
+                "rmses": jnp.where(ok, rmses, jnp.zeros_like(rmses)) * count,
+                "count": count,
+                "bad": 1.0 - okf,
+            }
+        else:
+            metrics = {"loss": loss * count, "rmses": rmses * count, "count": count}
         new_state = TrainState(
             params=new_params,
             batch_stats=new_bstats,
             opt_state=new_opt,
             step=state.step + 1,
         )
-        count = batch.count_real_graphs().astype(jnp.float32)
-        return new_state, {"loss": loss * count, "rmses": rmses * count, "count": count}
+        return new_state, metrics
 
     return body
 
 
-def make_train_step(model: HydraGNN, optimizer, donate: bool = True) -> Callable:
+def make_train_step(
+    model: HydraGNN, optimizer, donate: bool = True, guard: bool = False
+) -> Callable:
     # donate_argnums: params/opt_state buffers are reused in place, halving
     # HBM traffic for the state update (callers must drop the old state).
     return jax.jit(
-        _step_body(model, optimizer), donate_argnums=(0,) if donate else ()
+        _step_body(model, optimizer, guard), donate_argnums=(0,) if donate else ()
     )
 
 
@@ -195,15 +243,18 @@ def make_eval_step(model: HydraGNN) -> Callable:
 
 
 def make_train_epoch_scan(
-    model: HydraGNN, optimizer, donate: bool = True
+    model: HydraGNN, optimizer, donate: bool = True, guard: bool = False
 ) -> Callable:
     """Whole-epoch driver: one compiled call scans the train step over a
     stacked batch array [S, ...] (single dispatch per epoch instead of per
     step — the python-loop dispatch overhead dominates at HydraGNN's model
     sizes, hidden_dim 5-50 in every shipped config). Metrics come back summed
-    over steps, matching EpochMetrics' weighted accumulation."""
+    over steps, matching EpochMetrics' weighted accumulation. With ``guard``,
+    the per-step skip rides INSIDE the scan (a NaN step never poisons later
+    steps of the same chunk) and the summed ``bad`` metric reports how many
+    steps were skipped."""
 
-    body = _step_body(model, optimizer)
+    body = _step_body(model, optimizer, guard)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def epoch(state: TrainState, batches: GraphBatch, rng):
@@ -239,7 +290,7 @@ def _batch_pspec(batch: GraphBatch, graph_sharded: bool) -> GraphBatch:
 
 
 def make_train_step_dp(
-    model: HydraGNN, optimizer, mesh, donate: bool = True
+    model: HydraGNN, optimizer, mesh, donate: bool = True, guard: bool = False
 ) -> Callable:
     """SPMD step over a ('data', 'graph') mesh. ``batch`` arrays carry a leading
     device axis [D, ...] dealt over 'data'; when the model was built with
@@ -296,13 +347,29 @@ def make_train_step_dp(
         count_sum = jax.lax.psum(count, "data")
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = jax.tree_util.tree_map(lambda p, u: p + u, state.params, updates)
+        metrics = {"loss": loss_sum, "rmses": rmses_sum, "count": count_sum}
+        if guard:
+            # Checked AFTER the psum: a NaN on any shard propagates into the
+            # reduced grads/metrics, so every device computes the SAME flag
+            # and skips (or keeps) the replicated state update in lockstep.
+            ok = _all_finite(loss_sum, grads)
+            new_params = _keep_if(ok, new_params, state.params)
+            new_opt = _keep_if(ok, new_opt, state.opt_state)
+            new_bstats = _keep_if(ok, new_bstats, state.batch_stats)
+            okf = ok.astype(jnp.float32)
+            metrics = {
+                "loss": jnp.where(ok, loss_sum, 0.0),
+                "rmses": jnp.where(ok, rmses_sum, jnp.zeros_like(rmses_sum)),
+                "count": count_sum * okf,
+                "bad": 1.0 - okf,
+            }
         new_state = TrainState(
             params=new_params,
             batch_stats=new_bstats,
             opt_state=new_opt,
             step=state.step + 1,
         )
-        return new_state, {"loss": loss_sum, "rmses": rmses_sum, "count": count_sum}
+        return new_state, metrics
 
     platform = _mesh_platform(mesh)
 

@@ -82,17 +82,32 @@ def reduce_timers() -> Dict[str, Dict[str, float]]:
 
 
 def print_timers(verbosity: int = 0):
-    """Sorted-by-cost timer report at end of run (time_utils.py:95-138)."""
+    """Sorted-by-cost timer report at end of run (time_utils.py:95-138).
+    Fault-event counters (faults/counters.py) ride the same report: a run
+    that skipped steps, rolled back, retried transfers, or quarantined
+    samples says so at the end instead of surviving silently."""
     from .print_utils import print_distributed
 
     stats = reduce_timers()
-    if not stats:
+    try:
+        from ..faults.counters import FaultCounters
+
+        fault_counts = FaultCounters.snapshot()
+    except Exception:
+        fault_counts = {}
+    if not stats and not fault_counts:
         return
-    width = max(len(n) for n in stats)
-    lines = ["Timer report (seconds):"]
-    for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["max"]):
-        lines.append(
-            f"  {name:<{width}}  min={s['min']:.3f}  max={s['max']:.3f}  "
-            f"avg={s['avg']:.3f}"
-        )
+    lines = []
+    if stats:
+        width = max(len(n) for n in stats)
+        lines.append("Timer report (seconds):")
+        for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["max"]):
+            lines.append(
+                f"  {name:<{width}}  min={s['min']:.3f}  max={s['max']:.3f}  "
+                f"avg={s['avg']:.3f}"
+            )
+    if fault_counts:
+        lines.append("Fault counters:")
+        for name, n in sorted(fault_counts.items()):
+            lines.append(f"  {name}: {n}")
     print_distributed(verbosity, "\n".join(lines))

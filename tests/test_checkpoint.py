@@ -102,6 +102,111 @@ def pytest_periodic_checkpoint(tmp_path, monkeypatch):
     assert os.path.exists("logs/periodic_unit/periodic_unit.pk")
 
 
+def pytest_keep_last_k_retention_manifest_and_tmp_cleanup(tmp_path):
+    """save_model(keep_last_k=2): epoch-tagged retained checkpoints pruned to
+    the last 2 with an atomically-updated manifest, stale *.tmp litter from a
+    crashed earlier save removed at save entry, and the latest-checkpoint
+    contract (<name>.pk) intact."""
+    from hydragnn_tpu.utils.model import (
+        cleanup_stale_checkpoint_tmp,
+        load_checkpoint_manifest,
+        load_checkpoint_meta,
+    )
+
+    rng = np.random.default_rng(0)
+    model, variables, batch, _ = _tiny_setup(rng)
+    opt = select_optimizer("AdamW", 1e-3)
+    opt_state = opt.init(variables["params"])
+
+    run_dir = tmp_path / "ret_unit"
+    os.makedirs(run_dir)
+    # Torn leftovers of a crash mid-os.replace: must vanish on the next save.
+    (run_dir / "ret_unit.pk.tmp").write_bytes(b"torn")
+    for epoch in (1, 2, 3):
+        save_model(
+            variables, opt_state, "ret_unit", path=str(tmp_path) + "/",
+            meta={"epoch": epoch}, keep_last_k=2,
+        )
+    files = sorted(os.listdir(run_dir))
+    assert "ret_unit.pk.tmp" not in files, "stale tmp survived a save"
+    assert not glob.glob(str(run_dir / "*.tmp"))
+    # Latest + last-2 retained; epoch 1 pruned.
+    assert "ret_unit.pk" in files
+    assert "ret_unit.e000002.pk" in files and "ret_unit.e000003.pk" in files
+    assert "ret_unit.e000001.pk" not in files
+    manifest = load_checkpoint_manifest("ret_unit", path=str(tmp_path) + "/")
+    assert manifest["keep_last_k"] == 2
+    assert [e["epoch"] for e in manifest["entries"]] == [2, 3]
+    assert all(os.path.exists(run_dir / e["file"]) for e in manifest["entries"])
+    assert load_checkpoint_meta("ret_unit", path=str(tmp_path) + "/")["epoch"] == 3
+    # Retained files are loadable checkpoints (same payload as the latest).
+    from hydragnn_tpu.utils.model import load_checkpoint_file
+
+    restored, _, meta = load_checkpoint_file(
+        {"params": variables["params"], "batch_stats": {}},
+        str(run_dir / "ret_unit.e000002.pk"),
+    )
+    assert meta["epoch"] == 2
+    # Explicit startup cleanup helper (run_training resume path).
+    (run_dir / "junk.tmp").write_bytes(b"x")
+    removed = cleanup_stale_checkpoint_tmp(str(run_dir))
+    assert removed and not glob.glob(str(run_dir / "*.tmp"))
+
+
+def pytest_supervisor_restarts_killed_scan_run(tmp_path, monkeypatch):
+    """Crash-resume as a first-class API: run_training(supervise=True) with an
+    injected kill@K fault (HYDRAGNN_FAULTS) on the SCAN epoch path (mesh=None,
+    no profiler — the production single-device path). The child dies by
+    SIGKILL mid-run, the supervisor restarts it, Training.resume picks up the
+    periodic checkpoint, and the restart metadata (logs/<name>/supervisor.json)
+    records the death + completion."""
+    import json
+    import signal
+
+    from hydragnn_tpu.faults import read_supervisor_meta
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.utils.model import load_checkpoint_meta
+    from tests.deterministic_graph_data import deterministic_graph_data
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("SERIALIZED_DATA_PATH", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # children must stay on CPU
+    # kill@2: the scan path feeds one train batch per epoch here (24 samples,
+    # batch 32), so the third fed TRAIN batch = epoch 2 — after the epoch-1
+    # and epoch-2 periodic checkpoints landed. Fires only in incarnation 0
+    # (HYDRAGNN_RESTART_COUNT gating), so the restart completes.
+    monkeypatch.setenv("HYDRAGNN_FAULTS", "kill@2")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["Visualization"] = {"create_plots": False}
+    tr = config["NeuralNetwork"]["Training"]
+    tr["num_epoch"] = 4
+    tr["periodic_checkpoint_every"] = 1
+    for split, cnt in {"train": 24, "test": 8, "validate": 8}.items():
+        p = f"dataset/unit_test_singlehead_{split}"
+        os.makedirs(p, exist_ok=True)
+        deterministic_graph_data(p, number_configurations=cnt)
+        config["Dataset"]["path"][split] = p
+
+    meta = run_training(dict(config), supervise=True, max_restarts=2)
+
+    assert meta["completed"] is True
+    assert meta["restarts"] == 1, meta
+    assert len(meta["attempts"]) == 2
+    # First incarnation died by SIGKILL; the restart exited clean.
+    assert meta["attempts"][0]["returncode"] == -signal.SIGKILL
+    assert meta["attempts"][1]["returncode"] == 0
+    # The persisted metadata matches what the API returned.
+    from hydragnn_tpu.utils.config_utils import get_log_name_config
+
+    log_name = get_log_name_config(config)
+    on_disk = read_supervisor_meta(log_name)
+    assert on_disk["restarts"] == 1 and on_disk["completed"] is True
+    # The run actually finished all epochs after resume.
+    assert load_checkpoint_meta(log_name)["epoch"] == 4
+
+
 def pytest_crash_resume_after_kill(tmp_path, monkeypatch):
     """Training.resume (extension over the reference's weights-only warm
     start, SURVEY.md §5.3/5.4): a run SIGKILLed after its first periodic

@@ -29,6 +29,7 @@ from hydragnn_tpu.serve import (
     EngineClosedError,
     EngineFailedError,
     InferenceEngine,
+    NonFiniteOutputError,
 )
 
 
@@ -258,6 +259,107 @@ def pytest_worker_exception_reraises_at_caller_and_poisons_engine():
     assert "injected device failure" in str(exc_info.value.__cause__)
     assert engine.metrics.snapshot()["errors_total"] == 1
     engine.close()
+
+
+# ------------------------------------------------- fault tolerance (serving)
+@pytest.mark.mpi_skip
+def pytest_nonfinite_output_fails_request_not_engine():
+    """The serving reuse of the non-finite guard: a NaN model output fails
+    THAT request with NonFiniteOutputError; the engine stays running (marked
+    degraded, counters incremented) and later requests serve normally."""
+    engine, graphs = _tiny_engine(max_delay_ms=10.0)
+    real_execute = engine._execute
+    state = {"poison": True}
+
+    def nan_once(dev_batch):
+        outputs = real_execute(dev_batch)
+        if state.pop("poison", False):
+            outputs = [np.full_like(o, np.nan) for o in outputs]
+        return outputs
+
+    engine._execute = nan_once
+    try:
+        fut = engine.submit(graphs[0])
+        with pytest.raises(NonFiniteOutputError):
+            fut.result(timeout=30.0)
+        assert engine.running and engine._error is None
+        assert engine.degraded is True
+        snap = engine.metrics.snapshot()
+        assert snap["nonfinite_total"] == 1
+        assert snap["bad_batches_total"] == 1
+        # Subsequent traffic is unaffected.
+        out = engine.predict(graphs[1:3])
+        assert all(np.isfinite(np.asarray(h)).all() for r in out for h in r)
+    finally:
+        engine.close()
+
+
+@pytest.mark.mpi_skip
+def pytest_resolution_failure_is_batch_scoped_not_fatal():
+    """A failure in per-request post-processing (the resolve stage) fails the
+    batch's futures with the original error but keeps the engine serving —
+    only device/compile failures are engine-fatal."""
+    engine, graphs = _tiny_engine(max_delay_ms=10.0)
+    real_denorm = engine._denormalize
+    calls = {"n": 0}
+
+    def flaky(ihead, value):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("injected postprocess failure")
+        return real_denorm(ihead, value)
+
+    engine._denormalize = flaky
+    try:
+        fut = engine.submit(graphs[0])
+        with pytest.raises(ValueError, match="injected postprocess failure"):
+            fut.result(timeout=30.0)
+        assert engine.running and engine._error is None
+        assert engine.degraded is True
+        assert engine.metrics.snapshot()["bad_batches_total"] == 1
+        assert engine.predict(graphs[1:2])[0] is not None
+    finally:
+        engine.close()
+
+
+@pytest.mark.mpi_skip
+def pytest_worker_restart_budget_recovers_then_poisons():
+    """max_worker_restarts=1: the first fatal worker error fails the
+    in-flight futures but RESTARTS the pipeline (degraded, counter bumped,
+    traffic continues); the second exhausts the budget and poisons the
+    engine exactly like the historical behavior."""
+    engine, graphs = _tiny_engine(max_delay_ms=10.0, max_worker_restarts=1)
+    real_execute = engine._execute
+    state = {"fail": True}
+
+    def fail_once(dev_batch):
+        if state.pop("fail", False):
+            raise RuntimeError("injected device failure")
+        return real_execute(dev_batch)
+
+    engine._execute = fail_once
+    try:
+        fut = engine.submit(graphs[0])
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            fut.result(timeout=30.0)
+        # Restarted, not poisoned: still accepting and serving.
+        deadline = time.perf_counter() + 10.0
+        while not engine.running and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert engine.running and engine._error is None
+        assert engine.degraded is True
+        assert engine.metrics.snapshot()["engine_restarts_total"] == 1
+        assert engine.predict(graphs[1:3])[0] is not None
+
+        # Budget exhausted: next fatal error poisons.
+        state["fail"] = True
+        fut = engine.submit(graphs[0])
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            fut.result(timeout=30.0)
+        with pytest.raises(EngineFailedError):
+            engine.submit(graphs[1])
+    finally:
+        engine.close()
 
 
 # ----------------------------------------------------------- executable cache

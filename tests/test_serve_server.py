@@ -67,12 +67,18 @@ def pytest_serve_http_predict_healthz_metrics_end_to_end():
         with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
             health = json.loads(resp.read())
         assert health["ok"] is True and health["compiled_buckets"] >= 1
+        # The fault-tolerance surface: healthy AND un-degraded, with the
+        # restart/bad-batch counters exposed (docs/FAULT_TOLERANCE.md).
+        assert health["degraded"] is False
+        assert health["bad_batches"] == 0 and health["restarts"] == 0
 
         with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
             text = resp.read().decode()
         assert "hydragnn_serve_requests_total 2" in text
         assert 'hydragnn_serve_latency_seconds_bucket{stage="e2e"' in text
         assert "hydragnn_serve_bucket_cache_misses_total 1" in text
+        assert "hydragnn_serve_bad_batches_total 0" in text
+        assert "hydragnn_serve_engine_restarts_total 0" in text
 
         # Serving seconds surface in the shared Timer registry too.
         from hydragnn_tpu.utils.time_utils import Timer
