@@ -161,18 +161,24 @@ def _peak_workload():
         BATCH_SIZE, HIDDEN, LAYERS, STEPS, seed=0
     )
 
-    # Warmup dispatch, then timed windows.
+    # Warmup dispatch, then timed windows. The windows ride under the
+    # recompile sentinel: everything was AOT-compiled above, so a compile
+    # inside a timed window means the measurement is invalid — fail it
+    # loudly rather than publish a number with compile time folded in.
+    from hydragnn_tpu.analysis import no_recompile
+
     state, metrics = compiled(state, stacked, key)
     jax.block_until_ready(metrics["loss"])
 
     steps_per_window = STEPS * EPOCHS
     window_s = []
-    for _ in range(WINDOWS):
-        t0 = time.perf_counter()
-        for _ in range(EPOCHS):
-            state, metrics = compiled(state, stacked, key)
-        jax.block_until_ready(metrics["loss"])
-        window_s.append(time.perf_counter() - t0)
+    with no_recompile(action="raise", label="bench steady windows"):
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            for _ in range(EPOCHS):
+                state, metrics = compiled(state, stacked, key)
+            jax.block_until_ready(metrics["loss"])
+            window_s.append(time.perf_counter() - t0)
     # Headline = min-time window. Tunnel/RPC interference only ADDS time, so
     # the minimum is the standard low-variance estimator of true device
     # throughput; observed windows here span 0.30-0.55 ms/step run to run
@@ -530,6 +536,71 @@ def faults_main() -> int:
     return 0 if result["value"] == 1.0 else 1
 
 
+def analyze_main() -> int:
+    """``python bench.py --analyze``: the round's static-health line
+    (ANALYSIS_rNN.json) — graftlint rule hit counts + suppression count over
+    the package, and check-config wall time over the committed CI configs —
+    so the trajectory artifacts track static health alongside perf. CPU-safe
+    and hardware-free by construction."""
+    result = {
+        "metric": "static_analysis",
+        "value": 0.0,
+        "unit": "unsuppressed_violations",
+    }
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        sys.path.insert(0, repo)
+        from hydragnn_tpu.analysis import (
+            lint_paths,
+            load_baseline,
+            new_violations,
+        )
+
+        t0 = time.perf_counter()
+        report = lint_paths([os.path.join(repo, "hydragnn_tpu")], root=repo)
+        fresh = new_violations(report, load_baseline())
+        result.update(
+            value=float(len(report.violations)),
+            lint_s=round(time.perf_counter() - t0, 3),
+            files=report.files,
+            traced_functions=report.traced_functions,
+            rule_counts=report.counts(),
+            new_vs_baseline=len(fresh),
+            suppressions=len(report.suppressed),
+            suppression_reasons=[v.reason for v in report.suppressed],
+        )
+
+        from hydragnn_tpu.analysis import check_config
+
+        cc = {}
+        for name in ("ci.json", "ci_multihead.json", "ci_vectoroutput.json"):
+            t0 = time.perf_counter()
+            rep = check_config(
+                os.path.join(repo, "tests/inputs", name),
+                mode="training",
+                strict=False,
+            )
+            cc[name] = {
+                "ok": rep["ok"],
+                "wall_s": round(time.perf_counter() - t0, 3),
+                "eval_shape_s": rep["eval_shape_s"],
+            }
+        result["check_config"] = cc
+        result["check_config_wall_s"] = round(
+            sum(v["wall_s"] for v in cc.values()), 3
+        )
+        configs_ok = all(v["ok"] for v in cc.values())
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0 if result["new_vs_baseline"] == 0 and configs_ok else 1
+
+
 def serve_main() -> int:
     """``python bench.py --serve``: run the online-serving load benchmark
     (benchmarks/serve_load.py) and print its block as the round's serving
@@ -817,4 +888,6 @@ if __name__ == "__main__":
         sys.exit(serve_main())
     if "--faults" in sys.argv:
         sys.exit(faults_main())
+    if "--analyze" in sys.argv:
+        sys.exit(analyze_main())
     main()

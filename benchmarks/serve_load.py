@@ -182,11 +182,16 @@ def run_serve_benchmark(
     warm_snap = engine.metrics.snapshot()["bucket_cache"]
     buckets_after_warmup = len(engine._executables)
     try:
-        closed = closed_loop(engine, graphs, duration_s=duration_s)
-        open_levels = [
-            open_loop(engine, graphs, rps, duration_s=duration_s)
-            for rps in loads
-        ]
+        # Recompile sentinel (analysis/sentinel.py) over the measured load:
+        # action="count" so the watch CORROBORATES the cache-growth field
+        # below at the XLA level without failing the benchmark — the two
+        # must agree at 0 for a valid steady-state measurement.
+        with engine.no_recompile(action="count") as watch:
+            closed = closed_loop(engine, graphs, duration_s=duration_s)
+            open_levels = [
+                open_loop(engine, graphs, rps, duration_s=duration_s)
+                for rps in loads
+            ]
         block = {
             "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "platform": jax.default_backend(),
@@ -207,6 +212,10 @@ def run_serve_benchmark(
             # entry to the engine-lifetime cache.
             "recompiles_after_warmup": len(engine._executables)
             - buckets_after_warmup,
+            # XLA-level corroboration from the recompile sentinel: counts
+            # EVERY backend compile during the measured load, engine-cache
+            # or not.
+            "xla_compiles_during_load": watch.count,
             "saturation_graphs_per_sec": closed["achieved_graphs_per_sec"],
             "closed_loop": closed,
             "open_loop": open_levels,

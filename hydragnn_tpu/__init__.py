@@ -21,7 +21,8 @@ from .run_prediction import run_prediction
 
 # Imported after the subpackages above: serve builds on models/train/graphs;
 # faults threads through train/preprocess/serve (fault injection, non-finite
-# guard policy, crash-resume supervisor).
-from . import faults, serve
+# guard policy, crash-resume supervisor); analysis is the static-analysis
+# layer (graftlint, check-config, recompile sentinel — docs/STATIC_ANALYSIS.md).
+from . import analysis, faults, serve
 
 __version__ = "0.1.0"

@@ -1,0 +1,45 @@
+"""Static-analysis layer (docs/STATIC_ANALYSIS.md):
+
+* ``graftlint`` — framework-aware AST linter guarding the invariants PR 1-3
+  established in prose (host-sync-free step bodies, bit-inert guard,
+  donation safety, recompile hygiene, collation determinism).
+* ``check_config`` — static config/shape contract checker: ``jax.eval_shape``
+  over model + loss + guarded step against the declared dataset descriptors
+  and padded-arena buckets, before any device compile.
+* ``no_recompile`` — process-wide recompile sentinel (the serve engine's
+  executable-cache accounting, generalized).
+
+CLI: ``python -m hydragnn_tpu.analysis`` lints the package;
+``python -m hydragnn_tpu.analysis check-config <json>`` checks a config.
+
+This package deliberately imports nothing heavy at module scope — the linter
+half must stay usable (and fast) in contexts that never touch jax.
+"""
+
+from __future__ import annotations
+
+from .baseline import (
+    DEFAULT_BASELINE_PATH,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from .contracts import ConfigContractError, check_config, gate_config
+from .graftlint import Report, Violation, lint_paths
+from .sentinel import RecompileError, compile_count, no_recompile
+
+__all__ = [
+    "ConfigContractError",
+    "DEFAULT_BASELINE_PATH",
+    "RecompileError",
+    "Report",
+    "Violation",
+    "check_config",
+    "compile_count",
+    "gate_config",
+    "lint_paths",
+    "load_baseline",
+    "new_violations",
+    "no_recompile",
+    "save_baseline",
+]

@@ -1,0 +1,149 @@
+"""CLI for the static-analysis layer.
+
+    python -m hydragnn_tpu.analysis [lint] [paths...] [--json]
+        Lint (default: the hydragnn_tpu package). Exit 0 iff no violation
+        beyond the committed baseline; --update-baseline rewrites it.
+
+    python -m hydragnn_tpu.analysis check-config <config.json>
+        [--mode training|serving] [--bucket-ladder NxE,NxE] [--json]
+        Static contract check; exit 0 iff the config passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    DEFAULT_BASELINE_PATH,
+    check_config,
+    lint_paths,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from .contracts import ConfigContractError
+
+_PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_main(args) -> int:
+    paths = args.paths or [_PACKAGE_DIR]
+    root = os.path.dirname(_PACKAGE_DIR)
+    report = lint_paths(paths, root=root)
+    baseline = load_baseline(args.baseline)
+    fresh = new_violations(report, baseline)
+    if args.update_baseline:
+        entries = save_baseline(report, args.baseline)
+        print(f"baseline updated: {len(entries)} entrie(s) at {args.baseline}")
+        return 0
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "files": report.files,
+                    "traced_functions": report.traced_functions,
+                    "rule_counts": report.counts(),
+                    "violations": [v.format() for v in report.violations],
+                    "new_violations": [v.format() for v in fresh],
+                    "suppressed": [v.format() for v in report.suppressed],
+                    "baseline_entries": sum(baseline.values()),
+                    "ok": not fresh,
+                }
+            )
+        )
+    else:
+        for v in report.violations:
+            marker = "" if v.key in baseline else " [NEW]"
+            print(v.format() + marker)
+        for v in report.suppressed:
+            print(v.format() + f" — reason: {v.reason}")
+        print(
+            f"graftlint: {report.files} file(s), "
+            f"{report.traced_functions} traced function(s), "
+            f"{len(report.violations)} violation(s) "
+            f"({len(fresh)} new vs baseline), "
+            f"{len(report.suppressed)} suppressed"
+        )
+    return 1 if fresh else 0
+
+
+def _check_config_main(args) -> int:
+    ladder = None
+    if args.bucket_ladder:
+        ladder = []
+        for part in filter(None, (p.strip() for p in args.bucket_ladder.split(","))):
+            try:
+                n, e = part.split("x")
+                ladder.append((int(n), int(e)))
+            except ValueError:
+                # Malformed rung: hand the raw string to the checker, which
+                # reports it as a one-line oob-bucket finding instead of a
+                # parse traceback here.
+                ladder.append(part)
+    try:
+        report = check_config(
+            args.config, mode=args.mode, bucket_ladder=ladder, strict=False
+        )
+    except ConfigContractError as e:  # malformed beyond reporting
+        print(f"check-config: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for err in report["errors"]:
+            print(f"check-config: [{err['code']}] {err['message']}")
+        for s in report["skipped"]:
+            print(f"check-config: skipped — {s}")
+        status = "OK" if report["ok"] else "FAILED"
+        extra = (
+            f" (eval_shape {report['eval_shape_s']}s)"
+            if report.get("eval_shape_s") is not None
+            else ""
+        )
+        print(f"check-config: {status} [{report['mode']}]{extra}")
+    return 0 if report["ok"] else 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.analysis",
+        description="graftlint + static config contract checker",
+    )
+    sub = ap.add_subparsers(dest="cmd")
+    lint = sub.add_parser("lint", help="run graftlint (the default command)")
+    lint.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
+    lint.add_argument("--json", action="store_true")
+    lint.add_argument("--baseline", default=DEFAULT_BASELINE_PATH)
+    lint.add_argument("--update-baseline", action="store_true")
+    cc = sub.add_parser("check-config", help="static config contract check")
+    cc.add_argument("config")
+    cc.add_argument(
+        "--mode",
+        choices=("training", "prediction", "serving"),
+        default="training",
+    )
+    cc.add_argument(
+        "--bucket-ladder",
+        default="",
+        help='serving bucket shapes "NxE,NxE" to validate against the config',
+    )
+    cc.add_argument("--json", action="store_true")
+    return ap
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Default subcommand: bare invocation (or paths/flags only) means lint.
+    if not argv or argv[0] not in ("lint", "check-config", "-h", "--help"):
+        argv = ["lint"] + argv
+    args = build_parser().parse_args(argv)
+    if args.cmd == "check-config":
+        return _check_config_main(args)
+    return _lint_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

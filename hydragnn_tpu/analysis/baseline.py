@@ -1,0 +1,81 @@
+"""Committed violation baseline for graftlint.
+
+The baseline exists so the linter can be adopted mid-project without a
+flag-day: known violations are recorded here (by line-number-free key,
+``path::qualname::rule``) and tolerated, while any NEW violation fails
+loudly. Policy (enforced by tests/test_lint_clean.py + ISSUE 4): the
+baseline must stay EMPTY for ``host-sync-in-step`` and ``cond-in-guard`` —
+those two invariants are load-bearing for correctness (per-step host round
+trips, guard bit-inertness) and are never grandfathered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from .graftlint import Report, Violation
+
+# Rules that may never carry baseline entries.
+NO_BASELINE_RULES = ("host-sync-in-step", "cond-in-guard")
+
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Dict[str, int]:
+    """key -> tolerated count. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("entries", {})
+    bad = [
+        key
+        for key in entries
+        if any(key.endswith("::" + rule) for rule in NO_BASELINE_RULES)
+    ]
+    if bad:
+        raise ValueError(
+            f"baseline carries entries for never-grandfathered rules: {bad}"
+        )
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def save_baseline(report: Report, path: str = DEFAULT_BASELINE_PATH) -> Dict[str, int]:
+    """Write the report's violations as the new baseline (refusing the
+    never-grandfathered rules — those must be fixed, not recorded)."""
+    entries: Dict[str, int] = {}
+    refused: List[Violation] = []
+    for v in report.violations:
+        if v.rule in NO_BASELINE_RULES:
+            refused.append(v)
+        else:
+            entries[v.key] = entries.get(v.key, 0) + 1
+    if refused:
+        raise ValueError(
+            "refusing to baseline "
+            + "; ".join(v.format() for v in refused[:5])
+            + " — fix these, they are never grandfathered"
+        )
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return entries
+
+
+def new_violations(
+    report: Report, baseline: Dict[str, int]
+) -> List[Violation]:
+    """Violations not covered by the baseline (per-key counts respected:
+    a file that grows a second instance of a baselined violation fails)."""
+    budget = dict(baseline)
+    out: List[Violation] = []
+    for v in report.violations:
+        if budget.get(v.key, 0) > 0:
+            budget[v.key] -= 1
+        else:
+            out.append(v)
+    return out

@@ -1,0 +1,600 @@
+"""Static config/shape contract checker — ``check_config``.
+
+Catches broken training/serving configs BEFORE any device compile: the
+structural half cross-checks the JSON against the framework's config contract
+(head spec vs dataset descriptors, dtype validity, bucket feasibility,
+donation/distribution conflicts), and the shape half runs ``jax.eval_shape``
+over the FULL stack — model init, forward, multi-head loss, and the guarded
+train step — against a padded-arena example batch built from the declared
+descriptors. ``eval_shape`` only traces with abstract values: nothing is
+compiled, no device memory moves, and every input (batch AND rng) is passed
+as a ``ShapeDtypeStruct`` so the check cannot even allocate a device array —
+safe to run before ``jax.distributed.initialize`` ordering matters.
+
+Every failure is one actionable line tagged with a stable code:
+
+  missing-field     a key the entry point will dereference is absent
+  bad-head-spec     head types/indices/weights/heads blocks disagree
+  bad-arch          the Architecture block cannot build a model
+  dtype-mismatch    compute_dtype is not a floating dtype
+  oob-bucket        a bucket/batch/ladder size cannot hold the data
+  donation-misuse   config requests a donating step that would alias buffers
+  shape-mismatch    eval_shape found inconsistent shapes/dtypes end to end
+
+Exposed as ``python -m hydragnn_tpu.analysis check-config <json>`` and called
+at the top of run_training / run_prediction / serve startup.
+
+The eval_shape pass always uses AdamW regardless of ``Training.optimizer``:
+the contract being checked is model/loss/grad-step shape agreement, which is
+optimizer-independent, and tracing an LBFGS linesearch would multiply the
+check's cost for no additional shape coverage.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+HEAD_KINDS = ("graph", "node")
+
+
+class ConfigContractError(ValueError):
+    """One or more config contract violations; ``errors`` carries
+    (code, message) pairs, the str() is the first message + a count."""
+
+    def __init__(self, errors: List[Tuple[str, str]]):
+        self.errors = errors
+        first = f"[{errors[0][0]}] {errors[0][1]}" if errors else "config invalid"
+        extra = f" (+{len(errors) - 1} more)" if len(errors) > 1 else ""
+        super().__init__(first + extra)
+
+
+def _get(config: Dict[str, Any], *path, default=None):
+    cur: Any = config
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return default
+        cur = cur[key]
+    return cur
+
+
+# (fingerprint, mode) -> (errors, skipped, eval_shape_s). The eval_shape half
+# is pure in the model-relevant config subset, so repeated entry-point calls
+# on the same config (epoch-loop tests, supervisor restarts) pay the tracing
+# cost once per process.
+_SHAPE_CACHE: Dict[Tuple[str, str], Tuple[list, list, Any]] = {}
+
+
+def check_config(
+    config,
+    mode: str = "training",
+    bucket_ladder: Optional[Sequence[Tuple[int, int]]] = None,
+    strict: bool = True,
+    deep: bool = True,
+) -> Dict[str, Any]:
+    """Validate a training or serving config statically. Returns the report
+    dict; with ``strict`` (the default) raises :class:`ConfigContractError`
+    on any violation instead. ``deep=False`` skips the ``jax.eval_shape``
+    pass (structural checks only — the entry points use this when
+    ``HYDRAGNN_CHECK_CONFIG=structural``)."""
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if mode not in ("training", "prediction", "serving"):
+        raise ValueError(f"unknown check-config mode {mode!r}")
+    errors: List[Tuple[str, str]] = []
+    skipped: List[str] = []
+
+    arch = _get(config, "NeuralNetwork", "Architecture") or {}
+    voi = _get(config, "NeuralNetwork", "Variables_of_interest") or {}
+    training = _get(config, "NeuralNetwork", "Training") or {}
+    completed = all(k in arch for k in ("input_dim", "output_dim", "output_type"))
+
+    _check_structure(config, arch, voi, training, mode, completed, errors)
+    _check_head_spec(config, arch, voi, completed, errors)
+    _check_dtype(arch, errors)
+    _check_buckets(config, arch, training, bucket_ladder, mode, errors)
+    _check_donation(training, errors)
+
+    eval_shape_s = None
+    if not errors and not deep:
+        skipped.append("eval_shape: disabled (deep=False)")
+    elif not errors:
+        key = (
+            json.dumps(
+                {"arch": arch, "voi": voi, "ds": _get(config, "Dataset")},
+                sort_keys=True,
+                default=str,
+            ),
+            mode,
+        )
+        cached = _SHAPE_CACHE.get(key)
+        if cached is not None:
+            cached_errors, cached_skipped, eval_shape_s = cached
+            errors.extend(cached_errors)
+            skipped.extend(cached_skipped)
+        else:
+            shape_errors: List[Tuple[str, str]] = []
+            shape_skipped: List[str] = []
+            eval_shape_s = _check_shapes(
+                config, arch, voi, training, mode, completed,
+                shape_errors, shape_skipped,
+            )
+            _SHAPE_CACHE[key] = (shape_errors, shape_skipped, eval_shape_s)
+            errors.extend(shape_errors)
+            skipped.extend(shape_skipped)
+
+    report = {
+        "ok": not errors,
+        "mode": mode,
+        "completed_config": completed,
+        "errors": [{"code": c, "message": m} for c, m in errors],
+        "skipped": skipped,
+        "eval_shape_s": eval_shape_s,
+    }
+    if errors and strict:
+        raise ConfigContractError(errors)
+    return report
+
+
+def gate_config(config, mode: str = "training", bucket_ladder=None, deep=True):
+    """The ONE entry-point gate shared by run_training / run_prediction /
+    serve startup: honors ``HYDRAGNN_CHECK_CONFIG`` (``full`` default,
+    ``structural`` skips the eval_shape pass, ``off`` disables the gate) and
+    raises :class:`ConfigContractError` with one actionable line on a broken
+    config — before data loading and before any device compile."""
+    import os
+
+    level = os.environ.get("HYDRAGNN_CHECK_CONFIG", "full")
+    if level == "off":
+        return None
+    return check_config(
+        config,
+        mode=mode,
+        bucket_ladder=bucket_ladder,
+        deep=deep and level != "structural",
+    )
+
+
+# ------------------------------------------------------------------ structure
+def _check_structure(config, arch, voi, training, mode, completed, errors):
+    if not isinstance(_get(config, "NeuralNetwork"), dict):
+        errors.append(("missing-field", "config has no NeuralNetwork block"))
+        return
+    for key in ("model_type", "hidden_dim", "num_conv_layers", "output_heads",
+                "task_weights"):
+        if key not in arch:
+            errors.append(
+                ("missing-field", f"NeuralNetwork.Architecture.{key} is missing")
+            )
+    if mode == "serving":
+        if not completed:
+            missing = [
+                k
+                for k in ("input_dim", "output_dim", "output_type")
+                if k not in arch
+            ]
+            errors.append(
+                (
+                    "missing-field",
+                    "serving needs a COMPLETED config (missing Architecture."
+                    + "/".join(missing)
+                    + ") — pass the logs/<name>/config.json snapshot "
+                    "run_training wrote, not the raw input config",
+                )
+            )
+        return
+    # training mode: the data-driven completion contract needs these.
+    if _get(config, "Verbosity", "level") is None:
+        errors.append(("missing-field", "Verbosity.level is missing"))
+    ds = _get(config, "Dataset")
+    if not isinstance(ds, dict):
+        errors.append(
+            ("missing-field", "Dataset block is missing (training mode "
+             "loads and splits from Dataset.path)")
+        )
+    else:
+        for key in ("name", "path"):
+            if key not in ds:
+                errors.append(("missing-field", f"Dataset.{key} is missing"))
+        if isinstance(ds.get("path"), dict) and not ds["path"]:
+            errors.append(("missing-field", "Dataset.path is empty"))
+        kinds_used = set(voi.get("type") or ())
+        for kind in ("graph", "node"):
+            feat = f"{kind}_features"
+            if kind in kinds_used and not completed:
+                if not isinstance(_get(ds, feat, "dim"), list):
+                    errors.append(
+                        (
+                            "missing-field",
+                            f"Dataset.{feat}.dim is missing but the config "
+                            f"declares a {kind!r} head — completion cannot "
+                            "derive its output width",
+                        )
+                    )
+    for key in ("input_node_features", "type", "output_index"):
+        # Completed configs may omit type/output_index (Architecture carries
+        # output_type/output_dim) but never input_node_features.
+        if key not in voi and not (completed and key != "input_node_features"):
+            errors.append(
+                (
+                    "missing-field",
+                    f"NeuralNetwork.Variables_of_interest.{key} is missing",
+                )
+            )
+    # batch_size feeds the loaders on every entry point; the epoch-loop
+    # knobs only matter when a training loop will actually run.
+    required_training = (
+        ("batch_size",)
+        if mode == "prediction"
+        else ("batch_size", "learning_rate", "num_epoch")
+    )
+    for key in required_training:
+        if key not in training:
+            errors.append(
+                ("missing-field", f"NeuralNetwork.Training.{key} is missing")
+            )
+
+
+# ------------------------------------------------------------------ head spec
+def _check_head_spec(config, arch, voi, completed, errors):
+    types = list(
+        arch.get("output_type") if completed else (voi.get("type") or ())
+    )
+    if not types:
+        return
+    bad_kinds = [t for t in types if t not in HEAD_KINDS]
+    if bad_kinds:
+        errors.append(
+            (
+                "bad-head-spec",
+                f"unknown head kind(s) {bad_kinds} — every entry of "
+                "Variables_of_interest.type must be 'graph' or 'node'",
+            )
+        )
+    indices = voi.get("output_index")
+    if indices is not None and len(indices) != len(types):
+        errors.append(
+            (
+                "bad-head-spec",
+                f"{len(types)} head type(s) but {len(indices)} "
+                "output_index entries — the lists must be parallel",
+            )
+        )
+    weights = arch.get("task_weights")
+    if isinstance(weights, list) and len(weights) != len(types):
+        errors.append(
+            (
+                "bad-head-spec",
+                f"task_weights has {len(weights)} entries for {len(types)} "
+                "head(s) — one loss weight per head",
+            )
+        )
+    heads = arch.get("output_heads")
+    if isinstance(heads, dict):
+        for kind in sorted(set(types) & set(HEAD_KINDS)):
+            if kind not in heads:
+                errors.append(
+                    (
+                        "bad-head-spec",
+                        f"config declares a {kind!r} head but "
+                        f"Architecture.output_heads has no {kind!r} block",
+                    )
+                )
+    # Mirrors completion's _stage_edge_dim assertion, but as one line up
+    # front: only the edge-consuming conv stacks accept edge_features.
+    if arch.get("edge_features") and arch.get("model_type") not in (
+        "PNA",
+        "CGCNN",
+    ):
+        errors.append(
+            (
+                "bad-arch",
+                f"Architecture.edge_features declared but model_type "
+                f"{arch.get('model_type')!r} does not consume per-edge "
+                "features (PNA/CGCNN only)",
+            )
+        )
+    if completed:
+        dims = arch.get("output_dim") or []
+        if len(dims) != len(types):
+            errors.append(
+                (
+                    "bad-head-spec",
+                    f"completed config disagrees with itself: {len(dims)} "
+                    f"output_dim entries for {len(types)} output_type entries",
+                )
+            )
+    elif indices is not None and isinstance(_get(config, "Dataset"), dict):
+        for kind in HEAD_KINDS:
+            dims = _get(config, "Dataset", f"{kind}_features", "dim")
+            if not isinstance(dims, list):
+                continue
+            for i, (t, idx) in enumerate(zip(types, indices)):
+                if t == kind and not (
+                    isinstance(idx, int) and 0 <= idx < len(dims)
+                ):
+                    errors.append(
+                        (
+                            "bad-head-spec",
+                            f"head {i}: output_index {idx} is outside "
+                            f"Dataset.{kind}_features.dim (len {len(dims)})",
+                        )
+                    )
+
+
+# ---------------------------------------------------------------------- dtype
+def _check_dtype(arch, errors):
+    cd = arch.get("compute_dtype")
+    if cd is None:
+        return
+    import numpy as np
+
+    try:
+        dt = np.dtype(
+            {"bfloat16": np.float32}.get(cd, cd)
+        )  # np has no bfloat16; jnp accepts it — validate the rest via numpy
+        is_float = np.issubdtype(dt, np.floating) or cd == "bfloat16"
+    except TypeError:
+        errors.append(
+            (
+                "dtype-mismatch",
+                f"Architecture.compute_dtype {cd!r} is not a dtype",
+            )
+        )
+        return
+    if not is_float:
+        errors.append(
+            (
+                "dtype-mismatch",
+                f"Architecture.compute_dtype {cd!r} is not a floating dtype "
+                "— mixed-precision compute must be float (e.g. 'bfloat16')",
+            )
+        )
+
+
+# -------------------------------------------------------------------- buckets
+def _check_buckets(config, arch, training, bucket_ladder, mode, errors):
+    bs = training.get("batch_size")
+    if bs is not None and (not isinstance(bs, int) or bs < 1):
+        errors.append(
+            ("oob-bucket", f"Training.batch_size {bs!r} must be an int >= 1")
+        )
+    nb = _get(config, "Dataset", "num_buckets")
+    if nb is not None and (not isinstance(nb, int) or nb < 1):
+        errors.append(
+            ("oob-bucket", f"Dataset.num_buckets {nb!r} must be an int >= 1")
+        )
+    if bucket_ladder is not None:
+        num_nodes = arch.get("num_nodes")
+        best_n = 0
+        for rung in bucket_ladder:
+            # Explicit pair check first: a stray string would otherwise index
+            # as its characters ("64" -> (6, 4)) and mis-validate.
+            if not isinstance(rung, (tuple, list)) or len(rung) != 2:
+                errors.append(
+                    ("oob-bucket", f"bucket ladder rung {rung!r} is not (N_pad, E_pad)")
+                )
+                continue
+            try:
+                n, e = int(rung[0]), int(rung[1])
+            except (TypeError, ValueError):
+                errors.append(
+                    ("oob-bucket", f"bucket ladder rung {rung!r} is not (N_pad, E_pad)")
+                )
+                continue
+            if n < 2 or e < 1:
+                errors.append(
+                    (
+                        "oob-bucket",
+                        f"bucket ladder rung ({n}, {e}) cannot hold a graph "
+                        "(N_pad needs >= 1 real + 1 padding node)",
+                    )
+                )
+            best_n = max(best_n, n)
+        if num_nodes and best_n and best_n <= int(num_nodes):
+            errors.append(
+                (
+                    "oob-bucket",
+                    f"largest bucket ladder rung N_pad={best_n} cannot fit a "
+                    f"single num_nodes={num_nodes} graph (collate needs "
+                    "N_pad > total nodes)",
+                )
+            )
+    ga = training.get("graph_axis")
+    if ga is not None and (not isinstance(ga, int) or ga < 1):
+        errors.append(
+            ("oob-bucket", f"Training.graph_axis {ga!r} must be an int >= 1")
+        )
+
+
+# ------------------------------------------------------------------- donation
+def _check_donation(training, errors):
+    if str(training.get("optimizer", "")).upper() == "LBFGS" and int(
+        training.get("graph_axis") or 1
+    ) > 1:
+        errors.append(
+            (
+                "donation-misuse",
+                "Training.optimizer=LBFGS stores the params pytree in its "
+                "state (aliased buffers) — the distributed donating step "
+                "cannot run; use a first-order optimizer or drop graph_axis",
+            )
+        )
+
+
+# ----------------------------------------------------------------- eval_shape
+def _derive_model_spec(config, arch, voi, completed, errors, skipped):
+    """(input_dim, output_dim, output_type, edge_dim, num_nodes) or None."""
+    if completed:
+        return (
+            int(arch["input_dim"]),
+            [int(d) for d in arch["output_dim"]],
+            list(arch["output_type"]),
+            arch.get("edge_dim"),
+            int(arch.get("num_nodes") or 8),
+        )
+    types = voi.get("type")
+    indices = voi.get("output_index")
+    inputs = voi.get("input_node_features")
+    if not (types and indices is not None and inputs):
+        skipped.append("eval_shape: head spec underivable from this config")
+        return None
+    dims = []
+    for t, idx in zip(types, indices):
+        table = _get(config, "Dataset", f"{t}_features", "dim")
+        if not isinstance(table, list) or not (0 <= int(idx) < len(table)):
+            skipped.append(
+                "eval_shape: Dataset descriptors do not cover the head spec"
+            )
+            return None
+        dims.append(int(table[int(idx)]))
+    edge_features = arch.get("edge_features")
+    if edge_features:
+        edge_dim = len(edge_features)
+    elif arch.get("model_type") == "CGCNN":
+        edge_dim = 0
+    else:
+        edge_dim = None
+    return len(inputs), dims, list(types), edge_dim, int(arch.get("num_nodes") or 8)
+
+
+def _check_shapes(config, arch, voi, training, mode, completed, errors, skipped):
+    spec = _derive_model_spec(config, arch, voi, completed, errors, skipped)
+    if spec is None:
+        return None
+    input_dim, output_dim, output_type, edge_dim, num_nodes = spec
+
+    t0 = time.perf_counter()
+    import jax
+    import numpy as np
+
+    from ..models.create import create_model_config, make_example_batch
+
+    arch2 = dict(arch)
+    arch2.update(
+        input_dim=input_dim,
+        output_dim=output_dim,
+        output_type=output_type,
+        edge_dim=edge_dim,
+        num_nodes=num_nodes,
+    )
+    arch2.setdefault("freeze_conv_layers", False)
+    if arch2.get("model_type") == "PNA" and not arch2.get("pna_deg"):
+        mn = arch2.get("max_neighbours")
+        if mn is None:
+            errors.append(
+                (
+                    "bad-arch",
+                    "model_type=PNA needs Architecture.max_neighbours (the "
+                    "degree histogram bound) — completion cannot derive "
+                    "pna_deg without it",
+                )
+            )
+            return None
+        # Flat placeholder histogram: eval_shape only needs pna_deg's
+        # PRESENCE — output shapes do not depend on its values.
+        arch2["pna_deg"] = [1.0] * (int(mn) + 1)
+    try:
+        model = create_model_config(config=arch2, verbosity=0)
+    except Exception as e:  # noqa: BLE001 — every builder error is a finding
+        errors.append(
+            ("bad-arch", f"Architecture cannot build a model: {e}")
+        )
+        return None
+
+    example = make_example_batch(
+        input_dim, output_dim, output_type, edge_dim=edge_dim,
+        num_nodes=num_nodes,
+    )
+    batch_sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        example,
+    )
+    key_sds = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    def _trace_serving(batch, key):
+        from ..train.trainer import _apply_model
+
+        variables = model.init(
+            {"params": key, "dropout": key}, batch, train=False
+        )
+        return _apply_model(
+            model,
+            variables["params"],
+            variables.get("batch_stats", {}),
+            batch,
+            train=False,
+        )
+
+    def _trace_training(batch, key):
+        from ..train.trainer import _step_body, create_train_state
+        from ..utils.optimizer import select_optimizer
+
+        variables = model.init(
+            {"params": key, "dropout": key}, batch, train=False
+        )
+        # AdamW regardless of Training.optimizer: the shape contract is
+        # optimizer-independent (module docstring).
+        state = create_train_state(model, variables, select_optimizer("AdamW", 1e-3))
+        new_state, metrics = _step_body(
+            model, select_optimizer("AdamW", 1e-3), guard=True
+        )(state, batch, key)
+        return metrics
+
+    try:
+        if mode in ("serving", "prediction"):  # forward-only surfaces
+            out_shapes = jax.eval_shape(_trace_serving, batch_sds, key_sds)
+            _check_output_shapes(
+                out_shapes, output_dim, output_type, example, errors
+            )
+        else:
+            metrics = jax.eval_shape(_trace_training, batch_sds, key_sds)
+            loss = metrics["loss"]
+            if loss.shape != () or not np.issubdtype(loss.dtype, np.floating):
+                errors.append(
+                    (
+                        "shape-mismatch",
+                        f"guarded step loss has shape {loss.shape} dtype "
+                        f"{loss.dtype}; expected a floating scalar",
+                    )
+                )
+    except ConfigContractError:
+        raise
+    except Exception as e:  # noqa: BLE001 — trace errors ARE the findings
+        errors.append(
+            (
+                "shape-mismatch",
+                "eval_shape over model+loss+guarded step failed: "
+                + str(e).splitlines()[0],
+            )
+        )
+        return round(time.perf_counter() - t0, 4)
+    return round(time.perf_counter() - t0, 4)
+
+
+def _check_output_shapes(out_shapes, output_dim, output_type, example, errors):
+    if len(out_shapes) != len(output_dim):
+        errors.append(
+            (
+                "shape-mismatch",
+                f"model emits {len(out_shapes)} head(s); config declares "
+                f"{len(output_dim)}",
+            )
+        )
+        return
+    n_pad = example.node_features.shape[0]
+    g_pad = example.num_graphs_pad
+    for i, (shape, dim, kind) in enumerate(
+        zip(out_shapes, output_dim, output_type)
+    ):
+        want_rows = g_pad if kind == "graph" else n_pad
+        if tuple(shape.shape) != (want_rows, dim):
+            errors.append(
+                (
+                    "shape-mismatch",
+                    f"head {i} ({kind}): model emits {tuple(shape.shape)}, "
+                    f"config declares ({want_rows}, {dim})",
+                )
+            )

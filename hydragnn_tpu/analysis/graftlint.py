@@ -1,0 +1,1019 @@
+"""graftlint — the framework-aware AST linter (rule catalogue: rules.py,
+policy + examples: docs/STATIC_ANALYSIS.md).
+
+How it decides what is "traced": each file is parsed once; function/lambda
+definitions are indexed with qualnames; traced ROOTS are (a) functions
+decorated with a jax transform (``@jax.jit``, ``@functools.partial(jax.jit,
+...)``), (b) functions/lambdas passed as arguments to a transform call
+(``jax.jit(f)``, ``lax.scan(body, ...)``, ``shard_map(_local, ...)``),
+(c) nested definitions inside the framework's step-body factories
+(rules.TRACED_FACTORIES — ``_step_body`` returns its closure, which static
+analysis cannot see through), and (d) methods of flax ``nn.Module``
+subclasses (they run under ``model.init``/``model.apply`` tracing). The
+traced set is then propagated over the static call graph (name calls,
+``self.`` method calls, and cross-module ``from ... import`` edges within the
+linted file set) to a fixpoint; rules that only make sense in traced code run
+on exactly that set.
+
+The linter is intentionally conservative the other way for suppressions:
+``# graftlint: disable=<rule>(<reason>)`` on the violation's line (or the
+line above) suppresses it, and an empty reason is itself a violation —
+an unexplained suppression is a prose invariant again, which is the failure
+mode this module exists to end.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import rules as R
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_-]+)\s*(?:\(([^)]*)\))?"
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    qualname: str
+    suppressed: bool = False
+    reason: Optional[str] = None
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity for the committed baseline (stable
+        across unrelated edits to the same file)."""
+        return f"{self.path}::{self.qualname}::{self.rule}"
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+            f"{self.message} [{self.qualname}]{tag}"
+        )
+
+
+@dataclass
+class Report:
+    violations: List[Violation] = field(default_factory=list)
+    suppressed: List[Violation] = field(default_factory=list)
+    files: int = 0
+    traced_functions: int = 0
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {rid: 0 for rid in R.RULES}
+        for v in self.violations:
+            out[v.rule] = out.get(v.rule, 0) + 1
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# --------------------------------------------------------------------- helpers
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _own_walk(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    definitions (each nested def is its own FuncInfo)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own_statements(body: Sequence[ast.stmt]) -> Iterable[ast.stmt]:
+    """Statements of a function body in source order, recursing into control
+    flow but not into nested function definitions."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, _FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, attr, None)
+            if inner:
+                yield from _own_statements(inner)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            yield from _own_statements(handler.body)
+
+
+def _literal_int_positions(node: ast.AST) -> Tuple[int, ...]:
+    """donate_argnums / static_argnums literal → positions tuple."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+@dataclass
+class FuncInfo:
+    module: "ModuleInfo"
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    qualname: str
+    name: str  # simple name ("<lambda>" for lambdas)
+    parent: Optional["FuncInfo"]
+    class_name: Optional[str]
+    traced: bool = False
+    calls: List[Tuple[str, ast.Call]] = field(default_factory=list)
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class ModuleInfo:
+    """One parsed file: AST, import aliases, functions, suppressions."""
+
+    def __init__(self, path: str, relpath: str, dotted: Optional[str]):
+        self.path = path
+        self.relpath = relpath
+        self.dotted = dotted  # package-dotted module name, if inside a package
+        with open(path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, filename=path)
+        self.aliases: Dict[str, str] = {}  # local name -> canonical dotted
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (module, orig)
+        self.functions: List[FuncInfo] = []
+        self.func_by_node: Dict[ast.AST, FuncInfo] = {}
+        self.toplevel: Dict[str, FuncInfo] = {}
+        self.methods: Dict[Tuple[str, str], FuncInfo] = {}  # (class, meth)
+        self.suppressions: Dict[int, Tuple[str, Optional[str]]] = {}
+        self.module_classes: Set[str] = set()  # flax nn.Module subclasses
+        self._collect_imports()
+        self._collect_suppressions()
+        self._collect_functions()
+
+    # ------------------------------------------------------------ collection
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.aliases[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                        if alias.asname
+                        else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                src = self._resolve_from(node)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if src is not None:
+                        self.from_imports[local] = (src, alias.name)
+                    # Names imported from libraries resolve dotted-wise too
+                    # (``from jax import lax`` → lax.* = jax.lax.*).
+                    base = src if src is not None else (node.module or "")
+                    if base:
+                        self.aliases[local] = f"{base}.{alias.name}"
+
+    def _resolve_from(self, node: ast.ImportFrom) -> Optional[str]:
+        """Absolute dotted module for a from-import (relative ones resolved
+        against this module's package position)."""
+        if node.level == 0:
+            return node.module
+        if self.dotted is None:
+            return None
+        parts = self.dotted.split(".")
+        if len(parts) < node.level:
+            return None
+        base = parts[: len(parts) - node.level]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _collect_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    reason = m.group(2)
+                    reason = reason.strip() if reason else None
+                    self.suppressions[tok.start[0]] = (m.group(1), reason)
+        except tokenize.TokenError:
+            pass
+
+    def canonical(self, dotted: Optional[str]) -> Optional[str]:
+        """Rewrite the first segment of a dotted name through the module's
+        import aliases (``jnp.where`` → ``jax.numpy.where``)."""
+        if not dotted:
+            return dotted
+        head, _, rest = dotted.partition(".")
+        mapped = self.aliases.get(head, head)
+        return f"{mapped}.{rest}" if rest else mapped
+
+    def _collect_functions(self) -> None:
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.stack: List[FuncInfo] = []
+                self.class_stack: List[str] = []
+
+            def _add(self, node: ast.AST, name: str) -> FuncInfo:
+                parent = self.stack[-1] if self.stack else None
+                cls = self.class_stack[-1] if self.class_stack else None
+                prefix = (
+                    parent.qualname + ".<locals>."
+                    if parent
+                    else (cls + "." if cls else "")
+                )
+                info = FuncInfo(
+                    module=mod,
+                    node=node,
+                    qualname=prefix + name,
+                    name=name,
+                    parent=parent,
+                    class_name=cls if not parent else None,
+                )
+                mod.functions.append(info)
+                mod.func_by_node[node] = info
+                if parent is None and cls is None:
+                    mod.toplevel[name] = info
+                if parent is None and cls is not None:
+                    mod.methods[(cls, name)] = info
+                return info
+
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                for base in node.bases:
+                    d = mod.canonical(_dotted(base)) or ""
+                    if d.split(".")[-1] == "Module":
+                        mod.module_classes.add(node.name)
+                self.class_stack.append(node.name)
+                self.generic_visit(node)
+                self.class_stack.pop()
+
+            def _visit_fn(self, node: ast.AST, name: str) -> None:
+                info = self._add(node, name)
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
+                # Record this function's outgoing calls (own nodes only).
+                for sub in _own_walk(node):
+                    if isinstance(sub, ast.Call):
+                        d = _dotted(sub.func)
+                        if d:
+                            info.calls.append((d, sub))
+
+            def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+                self._visit_fn(node, node.name)
+
+            def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+                self._visit_fn(node, node.name)
+
+            def visit_Lambda(self, node: ast.Lambda) -> None:
+                self._visit_fn(node, "<lambda>")
+
+        V().visit(self.tree)
+
+
+# ---------------------------------------------------------------------- linter
+class Linter:
+    def __init__(self, paths: Sequence[str], root: Optional[str] = None):
+        self.files = sorted(self._expand(paths))
+        # Guard the derived root: commonpath raises on an empty list (typo'd
+        # path → zero .py files) and on mixed absolute/relative paths.
+        self.root = root or (
+            os.path.commonpath(
+                [os.path.dirname(os.path.abspath(f)) or "." for f in self.files]
+            )
+            if self.files
+            else "."
+        )
+        self.modules: List[ModuleInfo] = []
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+
+    @staticmethod
+    def _expand(paths: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [
+                        d for d in dirnames if d != "__pycache__"
+                    ]
+                    out.extend(
+                        os.path.join(dirpath, f)
+                        for f in filenames
+                        if f.endswith(".py")
+                    )
+            elif p.endswith(".py"):
+                out.append(p)
+        return out
+
+    def _dotted_name(self, path: str) -> Optional[str]:
+        """hydragnn_tpu-rooted dotted module name, if the file is inside the
+        package (used to resolve relative imports)."""
+        norm = path.replace(os.sep, "/")
+        marker = "hydragnn_tpu/"
+        idx = norm.rfind(marker)
+        if idx < 0:
+            return None
+        rel = norm[idx:].rsplit(".py", 1)[0]
+        return rel.replace("/", ".").removesuffix(".__init__")
+
+    # --------------------------------------------------------------- pipeline
+    def run(self) -> Report:
+        report = Report()
+        for path in self.files:
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                mod = ModuleInfo(path, rel, self._dotted_name(path))
+            except SyntaxError as e:
+                report.violations.append(
+                    Violation(
+                        rule="recompile-hazard",
+                        path=rel,
+                        line=e.lineno or 0,
+                        col=0,
+                        message=f"file does not parse: {e.msg}",
+                        qualname="<module>",
+                    )
+                )
+                continue
+            self.modules.append(mod)
+            if mod.dotted:
+                self.by_dotted[mod.dotted] = mod
+        report.files = len(self.modules)
+
+        self._mark_traced_roots()
+        self._propagate_traced()
+        report.traced_functions = sum(
+            1 for m in self.modules for f in m.functions if f.traced
+        )
+
+        for mod in self.modules:
+            self._lint_module(mod, report)
+        report.violations.sort(key=lambda v: (v.path, v.line, v.col))
+        report.suppressed.sort(key=lambda v: (v.path, v.line, v.col))
+        return report
+
+    # ------------------------------------------------------------ traced set
+    def _is_transform(self, mod: ModuleInfo, dotted: Optional[str]) -> bool:
+        if not dotted:
+            return False
+        canon = mod.canonical(dotted) or ""
+        tail2 = ".".join(canon.split(".")[-2:])
+        return (
+            dotted in R.TRANSFORM_ENTRY_POINTS
+            or canon in R.TRANSFORM_ENTRY_POINTS
+            or tail2 in R.TRANSFORM_ENTRY_POINTS
+        )
+
+    def _mark_traced_roots(self) -> None:
+        for mod in self.modules:
+            for fn in mod.functions:
+                node = fn.node
+                # (a) transform decorators, incl. functools.partial(jax.jit,..)
+                for dec in getattr(node, "decorator_list", ()):
+                    d = _dotted(dec)
+                    if self._is_transform(mod, d):
+                        fn.traced = True
+                    if isinstance(dec, ast.Call):
+                        dd = mod.canonical(_dotted(dec.func)) or ""
+                        if dd.split(".")[-1] == "partial" and dec.args:
+                            if self._is_transform(mod, _dotted(dec.args[0])):
+                                fn.traced = True
+                        elif self._is_transform(mod, _dotted(dec.func)):
+                            fn.traced = True
+                # (c) nested defs inside the step-body factories
+                p = fn.parent
+                while p is not None:
+                    if p.name in R.TRACED_FACTORIES:
+                        fn.traced = True
+                        break
+                    p = p.parent
+                # (d) flax Module methods
+                if fn.class_name and fn.class_name in mod.module_classes:
+                    fn.traced = True
+
+            # (b) callables passed to transform calls
+            for fn in mod.functions:
+                for dotted, call in fn.calls:
+                    if not self._is_transform(mod, dotted):
+                        continue
+                    cargs = list(call.args) + [
+                        kw.value for kw in call.keywords
+                    ]
+                    for arg in cargs:
+                        if isinstance(arg, ast.Lambda):
+                            info = mod.func_by_node.get(arg)
+                            if info:
+                                info.traced = True
+                        elif isinstance(arg, ast.Name):
+                            target = self._resolve_local(
+                                mod, fn, arg.id
+                            )
+                            if target:
+                                target.traced = True
+            # module-level transform calls (e.g. jax.jit(lambda ...) at
+            # import): walk module body outside functions
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and self._is_transform(
+                    mod, _dotted(node.func)
+                ):
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Lambda):
+                            info = mod.func_by_node.get(arg)
+                            if info:
+                                info.traced = True
+
+    def _resolve_local(
+        self, mod: ModuleInfo, fn: Optional[FuncInfo], name: str
+    ) -> Optional[FuncInfo]:
+        """Resolve a simple callee name: nested defs of enclosing functions,
+        then module-level functions, then cross-module from-imports."""
+        scope = fn
+        while scope is not None:
+            for child in mod.functions:
+                if child.parent is scope and child.name == name:
+                    return child
+            scope = scope.parent
+        if name in mod.toplevel:
+            return mod.toplevel[name]
+        imp = mod.from_imports.get(name)
+        if imp:
+            src_mod = self.by_dotted.get(imp[0])
+            if src_mod:
+                return src_mod.toplevel.get(imp[1])
+        return None
+
+    def _resolve_call(
+        self, mod: ModuleInfo, fn: FuncInfo, dotted: str
+    ) -> Optional[FuncInfo]:
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            return self._resolve_local(mod, fn, parts[0])
+        if parts[0] == "self" and len(parts) == 2 and fn.class_name:
+            return mod.methods.get((fn.class_name, parts[1]))
+        if parts[0] == "self" and len(parts) == 2 and fn.parent:
+            # method of the class enclosing a nested function
+            p = fn.parent
+            while p is not None and p.class_name is None:
+                p = p.parent
+            if p is not None and p.class_name:
+                return mod.methods.get((p.class_name, parts[1]))
+        if len(parts) == 2:
+            # module-alias call: alias.func where alias maps to a linted module
+            canon = mod.canonical(parts[0])
+            src_mod = self.by_dotted.get(canon or "")
+            if src_mod:
+                return src_mod.toplevel.get(parts[1])
+        return None
+
+    def _propagate_traced(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules:
+                for fn in mod.functions:
+                    if not fn.traced:
+                        continue
+                    for dotted, _ in fn.calls:
+                        target = self._resolve_call(mod, fn, dotted)
+                        if target is not None and not target.traced:
+                            target.traced = True
+                            changed = True
+
+    # ------------------------------------------------------------------ rules
+    def _emit(
+        self,
+        report: Report,
+        mod: ModuleInfo,
+        rule: str,
+        node: ast.AST,
+        message: str,
+        qualname: str,
+    ) -> None:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        v = Violation(
+            rule=rule,
+            path=mod.relpath,
+            line=line,
+            col=col,
+            message=message,
+            qualname=qualname,
+        )
+        for probe in (line, line - 1):
+            sup = mod.suppressions.get(probe)
+            if sup and sup[0] == rule and sup[1]:
+                v.suppressed = True
+                v.reason = sup[1]
+                report.suppressed.append(v)
+                return
+        report.violations.append(v)
+
+    def _lint_module(self, mod: ModuleInfo, report: Report) -> None:
+        # Bare suppressions (missing or empty justification) + unknown rules.
+        for line, (rule, reason) in sorted(mod.suppressions.items()):
+            if rule not in R.RULES:
+                report.violations.append(
+                    Violation(
+                        rule="suppression-without-reason",
+                        path=mod.relpath,
+                        line=line,
+                        col=0,
+                        message=f"suppression names unknown rule {rule!r}",
+                        qualname="<module>",
+                    )
+                )
+            elif not reason:
+                report.violations.append(
+                    Violation(
+                        rule="suppression-without-reason",
+                        path=mod.relpath,
+                        line=line,
+                        col=0,
+                        message=(
+                            f"disable={rule} needs a justification: "
+                            f"# graftlint: disable={rule}(why this is safe)"
+                        ),
+                        qualname="<module>",
+                    )
+                )
+        self._check_import_time(mod, report)
+        for fn in mod.functions:
+            guard_path = (
+                fn.name in R.GUARD_PATH_FUNCTIONS
+                or (
+                    fn.traced
+                    and any(
+                        mod.relpath.endswith(g) for g in R.GUARD_PATH_MODULES
+                    )
+                )
+            )
+            collation = any(
+                mod.relpath.endswith(c)
+                for c in R.COLLATION_DETERMINISTIC_MODULES
+            )
+            if fn.traced:
+                self._check_host_sync(mod, fn, report)
+            if guard_path:
+                self._check_cond_in_guard(mod, fn, report)
+            self._check_nondeterminism(mod, fn, report, collation)
+            self._check_donation(mod, fn, report)
+            self._check_recompile_fn(mod, fn, report)
+
+    # --- host-sync-in-step
+    def _check_host_sync(
+        self, mod: ModuleInfo, fn: FuncInfo, report: Report
+    ) -> None:
+        for node in _own_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in R.HOST_SYNC_METHODS
+            ):
+                self._emit(
+                    report,
+                    mod,
+                    "host-sync-in-step",
+                    node,
+                    f".{node.func.attr}() forces a host sync inside a "
+                    "step-reachable function",
+                    fn.qualname,
+                )
+                continue
+            canon = mod.canonical(_dotted(node.func))
+            if canon in R.HOST_SYNC_DOTTED or (
+                canon
+                and canon.startswith("numpy.")
+                and canon.split(".")[-1] in ("asarray", "array")
+            ):
+                self._emit(
+                    report,
+                    mod,
+                    "host-sync-in-step",
+                    node,
+                    f"{canon} materializes a traced value on the host",
+                    fn.qualname,
+                )
+                continue
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in R.HOST_SYNC_BUILTINS
+                and node.args
+                and self._nonstatic_arg(node.args[0])
+            ):
+                self._emit(
+                    report,
+                    mod,
+                    "host-sync-in-step",
+                    node,
+                    f"{node.func.id}() on a traced value is a host sync "
+                    "(ConcretizationError under jit)",
+                    fn.qualname,
+                )
+
+    @staticmethod
+    def _nonstatic_arg(arg: ast.AST) -> bool:
+        """True when the argument could be a traced value: not a literal and
+        not shape/dtype metadata (static at trace time)."""
+        if isinstance(arg, ast.Constant):
+            return False
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape",
+                "ndim",
+                "dtype",
+                "size",
+            ):
+                return False
+        # len(x) of a traced array is static
+        if (
+            isinstance(arg, ast.Call)
+            and isinstance(arg.func, ast.Name)
+            and arg.func.id == "len"
+        ):
+            return False
+        return True
+
+    # --- cond-in-guard
+    def _check_cond_in_guard(
+        self, mod: ModuleInfo, fn: FuncInfo, report: Report
+    ) -> None:
+        flag_names: Set[str] = set()
+        for node in _own_walk(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                callee = _dotted(node.value.func) or ""
+                canon = mod.canonical(callee) or ""
+                if callee.split(".")[-1] == "_all_finite" or canon.endswith(
+                    "numpy.isfinite"
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            flag_names.add(t.id)
+        for node in _own_walk(fn.node):
+            if isinstance(node, ast.Call):
+                canon = mod.canonical(_dotted(node.func)) or ""
+                tail2 = ".".join(canon.split(".")[-2:])
+                if tail2 in ("lax.cond", "lax.switch"):
+                    self._emit(
+                        report,
+                        mod,
+                        "cond-in-guard",
+                        node,
+                        f"{tail2} in guard-path code breaks bit-inertness — "
+                        "select with jnp.where instead",
+                        fn.qualname,
+                    )
+            if isinstance(node, (ast.If, ast.IfExp)) and flag_names:
+                for sub in ast.walk(node.test):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)
+                        and sub.id in flag_names
+                    ):
+                        self._emit(
+                            report,
+                            mod,
+                            "cond-in-guard",
+                            node,
+                            f"Python branch on all-finite flag {sub.id!r} — "
+                            "the guard must select with jnp.where",
+                            fn.qualname,
+                        )
+                        break
+
+    # --- nondeterminism
+    def _check_nondeterminism(
+        self, mod: ModuleInfo, fn: FuncInfo, report: Report, collation: bool
+    ) -> None:
+        if not (fn.traced or collation):
+            return
+        for node in _own_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.canonical(_dotted(node.func)) or ""
+            msg = None
+            if canon.startswith("numpy.random."):
+                attr = canon.split(".")[-1]
+                if attr == "default_rng" and not (node.args or node.keywords):
+                    msg = "np.random.default_rng() without a seed"
+                elif attr not in R.SEEDED_NP_RANDOM:
+                    msg = f"unseeded global-RNG call {canon}"
+            elif canon.split(".")[0] == "random" and "." in canon:
+                msg = f"stdlib global-RNG call {canon}"
+            elif fn.traced and canon in (
+                "time.time",
+                "time.perf_counter",
+                "time.monotonic",
+            ):
+                msg = f"{canon}() wall-clock read"
+            elif collation and canon == "time.time":
+                msg = "time.time() entropy"
+            elif canon.endswith("datetime.now") or canon.endswith(
+                "datetime.utcnow"
+            ):
+                msg = f"{canon}() wall-clock entropy"
+            if msg:
+                where = "traced" if fn.traced else "collation-deterministic"
+                self._emit(
+                    report,
+                    mod,
+                    "nondeterminism",
+                    node,
+                    f"{msg} in {where} code",
+                    fn.qualname,
+                )
+
+    # --- use-after-donate
+    def _class_donating(
+        self, mod: ModuleInfo, cls: str
+    ) -> Dict[str, Tuple[int, ...]]:
+        """Class-level donating bindings (``self.X = make_train_step(...)``),
+        computed ONCE per class (they depend only on the class's methods,
+        not on which method is being linted)."""
+        cache = getattr(mod, "_class_donating_cache", None)
+        if cache is None:
+            cache = {}
+            mod._class_donating_cache = cache  # type: ignore[attr-defined]
+        if cls in cache:
+            return cache[cls]
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for other in mod.functions:
+            if other.class_name != cls:
+                continue
+            for node in _own_walk(other.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                pos = self._donated_positions(mod, node.value)
+                if not pos:
+                    continue
+                for t in node.targets:
+                    d = _dotted(t)
+                    if d:
+                        donating[d] = pos
+        cache[cls] = donating
+        return donating
+
+    def _check_donation(
+        self, mod: ModuleInfo, fn: FuncInfo, report: Report
+    ) -> None:
+        # Class-level: self.X = make_train_step(...) binds a donating step
+        # visible from every method of the class.
+        cls = fn.class_name
+        p = fn.parent
+        while cls is None and p is not None:
+            cls = p.class_name
+            p = p.parent
+        donating = dict(self._class_donating(mod, cls)) if cls else {}
+        # Function-local bindings.
+        for node in _own_walk(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                pos = self._donated_positions(mod, node.value)
+                if pos:
+                    for t in node.targets:
+                        d = _dotted(t)
+                        if d:
+                            donating[d] = pos
+        if not donating:
+            return
+
+        if isinstance(fn.node, ast.Lambda):  # expression body: no statements
+            return
+        body = fn.node.body
+        statements = list(_own_statements(body))
+        # Loop bodies are walked twice so a donation in iteration k is seen by
+        # iteration k+1's loads.
+        loop_tails: List[ast.stmt] = []
+        for stmt in statements:
+            if isinstance(stmt, (ast.For, ast.While)):
+                loop_tails.extend(_own_statements(stmt.body))
+        dead: Dict[str, ast.Call] = {}
+        for stmt in statements + loop_tails:
+            self._donation_scan_stmt(
+                mod, fn, stmt, donating, dead, report
+            )
+
+    def _donated_positions(
+        self, mod: ModuleInfo, call: ast.Call
+    ) -> Tuple[int, ...]:
+        """Positions donated by the callable this call RETURNS (jax.jit with
+        donate_argnums, or a known donating factory)."""
+        canon = mod.canonical(_dotted(call.func)) or ""
+        name = (_dotted(call.func) or "").split(".")[-1]
+        if canon in ("jax.jit", "jit") or canon.endswith(".jit"):
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    return _literal_int_positions(kw.value) or ()
+            return ()
+        if name in R.DONATING_FACTORIES:
+            return R.DONATING_FACTORIES[name]
+        # functools.partial(jax.jit, donate_argnums=...) decorator-style
+        if canon.split(".")[-1] == "partial" and call.args:
+            inner = mod.canonical(_dotted(call.args[0])) or ""
+            if inner.endswith("jit"):
+                for kw in call.keywords:
+                    if kw.arg == "donate_argnums":
+                        return _literal_int_positions(kw.value)
+        return ()
+
+    def _donation_scan_stmt(
+        self,
+        mod: ModuleInfo,
+        fn: FuncInfo,
+        stmt: ast.stmt,
+        donating: Dict[str, Tuple[int, ...]],
+        dead: Dict[str, ast.Call],
+        report: Report,
+    ) -> None:
+        calls_here: List[ast.Call] = []
+        skip_nodes: Set[int] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, _FUNC_NODES):
+                skip_nodes.update(id(s) for s in ast.walk(node))
+        for node in ast.walk(stmt):
+            if id(node) in skip_nodes or not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            if callee in donating:
+                calls_here.append(node)
+        # 1) loads of already-dead names in this statement → violation
+        # (a donating call's OWN args are included on purpose: f(s); f(s)
+        # loads dead s at the second call and must be flagged)
+        for node in ast.walk(stmt):
+            if id(node) in skip_nodes:
+                continue
+            d = _dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+            if (
+                d
+                and d in dead
+                and isinstance(getattr(node, "ctx", None), ast.Load)
+            ):
+                donation = dead[d]
+                self._emit(
+                    report,
+                    mod,
+                    "use-after-donate",
+                    node,
+                    f"{d!r} was donated at line {donation.lineno} "
+                    f"({_dotted(donation.func)}(...)); its buffer is dead",
+                    fn.qualname,
+                )
+                del dead[d]  # one report per donation
+        # 2) donations made by this statement mark their args dead
+        for c in calls_here:
+            positions = donating[_dotted(c.func)]
+            for pos in positions:
+                if pos < len(c.args):
+                    d = _dotted(c.args[pos])
+                    if d:
+                        dead[d] = c
+        # 3) stores in this statement resurrect names (fresh binding)
+        for node in ast.walk(stmt):
+            if id(node) in skip_nodes:
+                continue
+            d = _dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+            if d and d in dead and isinstance(
+                getattr(node, "ctx", None), ast.Store
+            ):
+                del dead[d]
+
+    # --- recompile-hazard
+    def _check_import_time(self, mod: ModuleInfo, report: Report) -> None:
+        """jnp/jax.numpy device work executed at module import time — both
+        module-level statements and class-body statements (a class body runs
+        at import too; only function bodies are deferred)."""
+
+        def scan(stmts: Sequence[ast.stmt], where: str) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    scan(stmt.body, f"{where}{stmt.name}.")
+                    continue
+                for node in _own_walk(stmt):
+                    if isinstance(node, ast.Call):
+                        canon = mod.canonical(_dotted(node.func)) or ""
+                        if canon.startswith("jax.numpy."):
+                            self._emit(
+                                report,
+                                mod,
+                                "recompile-hazard",
+                                node,
+                                f"{canon} at module import time compiles "
+                                "and allocates before any entry point runs",
+                                where + "<module>",
+                            )
+
+        scan(mod.tree.body, "")
+
+    def _check_recompile_fn(
+        self, mod: ModuleInfo, fn: FuncInfo, report: Report
+    ) -> None:
+        # jit-wrapper construction inside a loop: a fresh wrapper per
+        # iteration re-traces and re-compiles every time. Nested function
+        # bodies are excluded — a closure DEFINED in a loop defers its jit
+        # construction to call time.
+        for node in _own_walk(fn.node):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            deferred: Set[int] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, _FUNC_NODES):
+                    deferred.update(id(s) for s in ast.walk(sub) if s is not sub)
+            for sub in ast.walk(node):
+                if id(sub) in deferred:
+                    continue
+                if isinstance(sub, ast.Call):
+                    canon = mod.canonical(_dotted(sub.func)) or ""
+                    if canon in ("jax.jit", "jit") or canon == "jax.pmap":
+                        self._emit(
+                            report,
+                            mod,
+                            "recompile-hazard",
+                            sub,
+                            f"{canon}(...) constructed inside a loop — each "
+                            "iteration re-traces and re-compiles",
+                            fn.qualname,
+                        )
+        # Unhashable literals at static positions of a locally-bound jit.
+        static_pos: Dict[str, Tuple[int, ...]] = {}
+        for node in _own_walk(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                canon = mod.canonical(_dotted(node.value.func)) or ""
+                if canon in ("jax.jit", "jit"):
+                    for kw in node.value.keywords:
+                        if kw.arg == "static_argnums":
+                            pos = _literal_int_positions(kw.value)
+                            if pos:
+                                for t in node.targets:
+                                    d = _dotted(t)
+                                    if d:
+                                        static_pos[d] = pos
+        if static_pos:
+            for node in _own_walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d not in static_pos:
+                    continue
+                for pos in static_pos[d]:
+                    if pos < len(node.args) and isinstance(
+                        node.args[pos],
+                        (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp),
+                    ):
+                        self._emit(
+                            report,
+                            mod,
+                            "recompile-hazard",
+                            node.args[pos],
+                            f"unhashable literal at static_argnums position "
+                            f"{pos} of {d} — every call re-traces (or "
+                            "raises); pass a tuple",
+                            fn.qualname,
+                        )
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None) -> Report:
+    """Lint files/directories; returns the Report (violations exclude
+    properly-suppressed ones, which land in ``report.suppressed``)."""
+    return Linter(paths, root=root).run()

@@ -1,0 +1,167 @@
+"""graftlint rule catalogue + the framework knowledge the rules key off.
+
+Every rule guards an invariant this framework PAID to establish and that
+nothing mechanical checked before this module existed (docs/STATIC_ANALYSIS.md
+has the full catalogue with examples):
+
+* ``host-sync-in-step``   — no host synchronization inside code reachable from
+  a compiled step body (trainer._step_body, the scan/shard_map paths, the
+  serve worker's jitted forward). A ``.item()`` / ``np.asarray`` / ``float()``
+  on a traced value either fails at trace time or — worse — silently forces a
+  device round-trip per step when the function also runs eagerly.
+* ``cond-in-guard``       — the non-finite step guard must stay bit-inert:
+  ``jnp.where`` selects, never ``lax.cond`` (a conditional region moves XLA's
+  fusion boundaries; the clean path then stops being bit-identical to the
+  unguarded build — measured, trainer._keep_if's docstring).
+* ``use-after-donate``    — a buffer passed at a donated position of a
+  ``donate_argnums`` callable is dead; reading it afterwards is undefined
+  behavior that XLA only sometimes reports.
+* ``recompile-hazard``    — patterns that silently multiply compiles:
+  jnp work at module import time, jit-wrapper construction inside a loop,
+  unhashable literals fed to static args.
+* ``nondeterminism``      — wall-clock / global-RNG entropy in traced code or
+  in the collation path (collation must be a pure function of (dataset, seed,
+  epoch) for the resume/replay contracts to hold).
+
+``suppression-without-reason`` is the meta-rule: every inline
+``# graftlint: disable=<rule>(<reason>)`` must carry a justification string.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+
+
+RULES = {
+    r.id: r
+    for r in (
+        Rule(
+            "host-sync-in-step",
+            "host-sync call (.item()/.tolist()/float()/np.asarray/"
+            "jax.device_get/block_until_ready) in code reachable from a "
+            "compiled step body",
+        ),
+        Rule(
+            "cond-in-guard",
+            "lax.cond/lax.switch or Python branching on the all-finite flag "
+            "in guard-path code — the guard must stay bit-inert (jnp.where)",
+        ),
+        Rule(
+            "use-after-donate",
+            "read of a buffer after it was passed at a donated position of a "
+            "donate_argnums callable",
+        ),
+        Rule(
+            "recompile-hazard",
+            "silent compile multiplier: jnp work at import time, jit "
+            "construction inside a loop, unhashable static-arg literal",
+        ),
+        Rule(
+            "nondeterminism",
+            "wall-clock or global-RNG entropy in traced or "
+            "collation-deterministic code",
+        ),
+        Rule(
+            "suppression-without-reason",
+            "graftlint suppression comment without a justification string",
+        ),
+    )
+}
+
+
+# --------------------------------------------------------------- framework map
+# Factories whose NESTED function definitions are compiled step bodies even
+# though the jit/scan wrapping happens at the call site (trainer.py's
+# ``_step_body`` returns ``body``; make_train_step jits it later). Static
+# call-graph analysis cannot see through the closure return, so the linter is
+# told directly.
+TRACED_FACTORIES = frozenset(
+    {
+        "_step_body",
+        "make_train_step",
+        "make_eval_step",
+        "make_train_epoch_scan",
+        "make_train_step_dp",
+        "make_eval_step_dp",
+    }
+)
+
+# Callables that return a donating compiled step (donate_argnums=(0,)):
+# calling one binds a callable whose argument 0 buffer set is consumed.
+DONATING_FACTORIES = {
+    "make_train_step": (0,),
+    "make_train_step_dp": (0,),
+    "make_train_epoch_scan": (0,),
+}
+
+# jax transforms whose callable arguments become traced roots.
+TRANSFORM_ENTRY_POINTS = frozenset(
+    {
+        "jax.jit",
+        "jit",
+        "jax.pmap",
+        "jax.vmap",
+        "vmap",
+        "jax.grad",
+        "jax.value_and_grad",
+        "jax.checkpoint",
+        "jax.remat",
+        "jax.eval_shape",
+        "jax.lax.scan",
+        "lax.scan",
+        "jax.lax.while_loop",
+        "lax.while_loop",
+        "jax.lax.fori_loop",
+        "lax.fori_loop",
+        "jax.lax.cond",
+        "lax.cond",
+        "jax.lax.switch",
+        "lax.switch",
+        "shard_map",
+        "jax.experimental.shard_map.shard_map",
+        "pl.pallas_call",
+        "pallas_call",
+    }
+)
+
+# Module-path substrings whose TRACED functions form the guard path — the
+# bit-inertness invariant scope for ``cond-in-guard``.
+GUARD_PATH_MODULES = ("train/trainer.py",)
+# Functions that are guard-path regardless of module (helpers the guard owns).
+GUARD_PATH_FUNCTIONS = frozenset({"_keep_if", "_all_finite"})
+
+# Module-path substrings where collation/splitting determinism is contractual:
+# batches must be a pure function of (dataset, seed, epoch) or crash-resume
+# replay and the device-cache epochs diverge from the streamed path.
+COLLATION_DETERMINISTIC_MODULES = (
+    "graphs/collate.py",
+    "graphs/batch.py",
+    "graphs/sample.py",
+    "preprocess/dataloader.py",
+    "preprocess/splitting.py",
+)
+
+# Host-sync call patterns (attribute tails / dotted names / builtins).
+HOST_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+HOST_SYNC_DOTTED = frozenset(
+    {
+        "jax.device_get",
+        "jax.block_until_ready",
+        "np.asarray",
+        "np.array",
+        "numpy.asarray",
+        "numpy.array",
+    }
+)
+HOST_SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+
+# np.random attributes that are fine (explicitly-seeded generator plumbing).
+SEEDED_NP_RANDOM = frozenset(
+    {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox"}
+)

@@ -1,0 +1,106 @@
+"""Recompile sentinel: process-wide XLA-compile accounting + the
+``no_recompile()`` context manager.
+
+This generalizes the serve engine's explicit compiled-executable cache
+accounting (serve/metrics.py counts hits/misses because the engine owns its
+cache) to ANY code path: JAX emits exactly one
+``/jax/core/compile/backend_compile_duration`` monitoring event per real XLA
+compilation — jit cache misses and explicit ``.lower().compile()`` both fire
+it, cache hits and executions do not (verified against this container's
+jax). One listener increments a process-wide counter; ``no_recompile()``
+snapshots it around a region that is contractually post-warmup:
+
+    with no_recompile(label="steady epochs") as watch:
+        for _ in range(epochs):
+            driver.train_epoch(loader)
+    # watch.count == 0, or RecompileError listing label + count
+
+Used by the trainer's device-cached replay epochs (warn by default — a
+production run must not die on an unexpected compile, but the operator must
+see it), by bench.py's steady measurement windows and the serving load
+benchmark (action="raise" — a recompile there invalidates the measurement),
+and by tests locking the zero-recompile-after-warmup contracts.
+
+The listener counts compiles from ALL threads — deliberate: the serve
+engine compiles on its dispatch thread, and those are exactly the compiles a
+post-warmup serving assertion must see.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import warnings
+from dataclasses import dataclass
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_lock = threading.Lock()
+_state = {"installed": False, "compiles": 0}
+
+
+class RecompileError(RuntimeError):
+    """A region declared recompile-free compiled anyway."""
+
+
+def _on_event(name: str, duration: float, **kwargs) -> None:
+    if name == _COMPILE_EVENT:
+        with _lock:
+            _state["compiles"] += 1
+
+
+def _ensure_listener() -> None:
+    with _lock:
+        if _state["installed"]:
+            return
+        _state["installed"] = True
+    import jax
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+
+def compile_count() -> int:
+    """Total XLA compilations observed in this process (since the first
+    sentinel use — call early if absolute counts matter)."""
+    _ensure_listener()
+    with _lock:
+        return _state["compiles"]
+
+
+@dataclass
+class RecompileWatch:
+    label: str
+    start: int
+    count: int = 0
+
+    @property
+    def compiles(self) -> int:  # alias; reads naturally at call sites
+        return self.count
+
+
+@contextlib.contextmanager
+def no_recompile(allow: int = 0, action: str = "raise", label: str = ""):
+    """Assert the wrapped region performs at most ``allow`` XLA compiles.
+
+    action: "raise" → RecompileError; "warn" → warnings.warn (production
+    paths — visible, never fatal); "count" → record only (the yielded
+    ``RecompileWatch.count`` carries the tally either way).
+    """
+    if action not in ("raise", "warn", "count"):
+        raise ValueError(f"unknown no_recompile action {action!r}")
+    _ensure_listener()
+    watch = RecompileWatch(label=label, start=compile_count())
+    try:
+        yield watch
+    finally:
+        watch.count = compile_count() - watch.start
+    if watch.count > allow:
+        msg = (
+            f"no_recompile({label or 'region'}): {watch.count} XLA "
+            f"compilation(s) in a region declared recompile-free "
+            f"(allow={allow}) — a warmup is incomplete or a static shape / "
+            "hashable-arg contract broke"
+        )
+        if action == "raise":
+            raise RecompileError(msg)
+        if action == "warn":
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)

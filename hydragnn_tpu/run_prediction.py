@@ -36,6 +36,12 @@ def _(config_file: str, mesh=None):
 def _(config: dict, mesh=None):
     os.environ.setdefault("SERIALIZED_DATA_PATH", os.getcwd())
     world_size, _rank = setup_ddp()
+    # Same static contract gate as run_training, in prediction mode: the
+    # epoch-loop Training knobs are not required and only the forward path
+    # is shape-checked (docs/STATIC_ANALYSIS.md).
+    from .analysis.contracts import gate_config
+
+    gate_config(config, mode="prediction")
     from .parallel.distributed import config_graph_axis
 
     graph_axis = config_graph_axis(config)

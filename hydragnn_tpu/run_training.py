@@ -48,6 +48,13 @@ def _(config_file: str, mesh=None, supervise=False, max_restarts=3):
 @run_training.register
 def _(config: dict, mesh=None, supervise=False, max_restarts=3):
     if supervise:
+        # Structural-only gate here (deep=False needs no XLA backend, which
+        # must not initialize before the children's jax.distributed
+        # bootstrap); each child re-enters run_training and runs the full
+        # gate (docs/STATIC_ANALYSIS.md).
+        from .analysis.contracts import gate_config
+
+        gate_config(config, deep=False)
         # Crash-resume supervisor (docs/FAULT_TOLERANCE.md): the training run
         # happens in child processes under a restart loop around the periodic
         # checkpoint + Training.resume contract. Returns the restart metadata
@@ -68,6 +75,12 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
     # jax.process_index(), which initializes the XLA backend —
     # jax.distributed.initialize must run first).
     world_size, world_rank = setup_ddp()
+    # Contract gate AFTER the distributed bootstrap (the eval_shape pass may
+    # initialize the XLA backend) but BEFORE data loading and any compile
+    # (docs/STATIC_ANALYSIS.md; HYDRAGNN_CHECK_CONFIG=full|structural|off).
+    from .analysis.contracts import gate_config
+
+    gate_config(config, mode="training")
     setup_log(get_log_name_config(config))
     # Config-level mesh request (beyond-reference): Training.graph_axis > 1
     # shards each graph's edges over that many devices (the FeSi_1024-style

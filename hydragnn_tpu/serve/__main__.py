@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     ladder = parse_ladder(args.bucket_ladder) if args.bucket_ladder else None
+    # Static contract gate (docs/STATIC_ANALYSIS.md): a broken completed
+    # config or an infeasible bucket ladder is one actionable line at
+    # startup, not a mid-warmup stack trace after the checkpoint loaded.
+    from ..analysis.contracts import gate_config
+
+    gate_config(args.config, mode="serving", bucket_ladder=ladder)
     engine = InferenceEngine.from_config(
         args.config,
         checkpoint=args.ckpt,

@@ -573,8 +573,6 @@ class InferenceEngine:
         return work, dev
 
     def _executable_for(self, dev_batch):
-        import jax
-
         key = (
             dev_batch.num_nodes_pad,
             dev_batch.num_edges_pad,
@@ -589,6 +587,19 @@ class InferenceEngine:
         else:
             self.metrics.count("cache_hits_total")
         return exe
+
+    def no_recompile(self, allow: int = 0, action: str = "raise"):
+        """Post-warmup steady-state assertion, generalized from this engine's
+        executable-cache accounting into the shared recompile sentinel
+        (analysis/sentinel.py): the wrapped region must not trigger ANY XLA
+        compilation — not just engine cache misses, also stray jit traffic
+        from co-resident code. Load tests and the serving benchmark wrap
+        their measured windows with it."""
+        from ..analysis import no_recompile as _no_recompile
+
+        return _no_recompile(
+            allow=allow, action=action, label="serve steady state"
+        )
 
     def _execute(self, dev_batch) -> List[np.ndarray]:
         """Run the (cached) compiled executable; host numpy outputs."""

@@ -10,6 +10,7 @@ and the TensorBoard writer actually works (model.py:50-54 quirk)."""
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import List, Optional
 
@@ -360,27 +361,47 @@ class TrainingDriver:
             rng = np.random.default_rng(
                 getattr(loader, "seed", 0) + getattr(loader, "epoch", 0)
             )
-            for ci in rng.permutation(len(cached["chunks"])):
-                single, payload = cached["chunks"][ci]
-                with timed_consume(self.feed_stats, "step_s"):
-                    if single:
-                        self.state, m = self.train_step(
-                            self.state, payload, self.rng
-                        )
-                    else:
-                        # Batch-level order reshuffle WITHIN the chunk too —
-                        # compiled into the scan dispatch (see _perm_scan), so
-                        # the mode's "order reshuffles per epoch" promise holds
-                        # even when the whole epoch fits one chunk. Membership
-                        # and batch->chunk assignment stay frozen (the cache).
-                        steps = jax.tree_util.tree_leaves(payload)[0].shape[0]
-                        perm = jnp.asarray(rng.permutation(steps))
-                        self.state, m = self._perm_scan(
-                            self.state, payload, perm, self.rng
-                        )
-                    metrics.update(m)
-                if self.guard is not None:
-                    self.guard.after_update(self, m)
+            # Recompile sentinel over steady replay epochs: the FIRST replay
+            # epoch legitimately compiles the permuted-replay dispatch
+            # (_perm_scan); from the second on, every executable exists and a
+            # compile means a static-shape contract broke. Warn (never die)
+            # in production; HYDRAGNN_NO_RECOMPILE=raise hardens it for
+            # benchmarks/tests, =off silences it.
+            from ..analysis import no_recompile
+
+            sentinel_action = os.environ.get("HYDRAGNN_NO_RECOMPILE", "warn")
+            if sentinel_action not in ("raise", "warn", "count", "off"):
+                # An observability knob must never kill a training run: a
+                # typo'd value degrades to the default, not a ValueError.
+                sentinel_action = "warn"
+            sentinel = (
+                no_recompile(action=sentinel_action, label="cached replay epoch")
+                if cached.get("warm") and sentinel_action != "off"
+                else contextlib.nullcontext()
+            )
+            with sentinel:
+                for ci in rng.permutation(len(cached["chunks"])):
+                    single, payload = cached["chunks"][ci]
+                    with timed_consume(self.feed_stats, "step_s"):
+                        if single:
+                            self.state, m = self.train_step(
+                                self.state, payload, self.rng
+                            )
+                        else:
+                            # Batch-level order reshuffle WITHIN the chunk too —
+                            # compiled into the scan dispatch (see _perm_scan), so
+                            # the mode's "order reshuffles per epoch" promise holds
+                            # even when the whole epoch fits one chunk. Membership
+                            # and batch->chunk assignment stay frozen (the cache).
+                            steps = jax.tree_util.tree_leaves(payload)[0].shape[0]
+                            perm = jnp.asarray(rng.permutation(steps))
+                            self.state, m = self._perm_scan(
+                                self.state, payload, perm, self.rng
+                            )
+                        metrics.update(m)
+                    if self.guard is not None:
+                        self.guard.after_update(self, m)
+            cached["warm"] = True
             self._credit_timers("train")
             return metrics.averages()
 

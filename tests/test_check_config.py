@@ -1,0 +1,243 @@
+"""Static config contract checker (analysis/contracts.py): every broken-config
+class is rejected with one actionable line BEFORE any device compile (locked
+via the recompile sentinel), valid committed configs pass, and the CLI +
+entry-point wiring behave."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hydragnn_tpu.analysis import (
+    ConfigContractError,
+    check_config,
+    compile_count,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CONFIG = os.path.join(_REPO, "tests", "inputs", "ci_multihead.json")
+
+
+def _base():
+    with open(_CONFIG) as f:
+        return json.load(f)
+
+
+def _expect(code, mutate, **kwargs):
+    config = _base()
+    mutate(config)
+    with pytest.raises(ConfigContractError) as err:
+        check_config(config, mode="training", **kwargs)
+    codes = [c for c, _ in err.value.errors]
+    assert code in codes, f"wanted {code}, got {err.value.errors}"
+    # Actionable single-line messages: every error is one line of text.
+    assert all("\n" not in m for _, m in err.value.errors)
+    return err.value
+
+
+# --------------------------------------------------- the broken-config classes
+def pytest_rejects_bad_head_spec():
+    e = _expect(
+        "bad-head-spec",
+        lambda c: c["NeuralNetwork"]["Variables_of_interest"]["type"].__setitem__(
+            0, "edge"
+        ),
+        deep=False,
+    )
+    assert "'graph' or 'node'" in str(e)
+    _expect(
+        "bad-head-spec",
+        lambda c: c["NeuralNetwork"]["Architecture"].__setitem__(
+            "task_weights", [1.0]
+        ),
+        deep=False,
+    )
+    _expect(
+        "bad-head-spec",
+        lambda c: c["NeuralNetwork"]["Variables_of_interest"][
+            "output_index"
+        ].__setitem__(1, 7),
+        deep=False,
+    )
+    _expect(
+        "bad-head-spec",
+        lambda c: c["NeuralNetwork"]["Architecture"]["output_heads"].pop("node"),
+        deep=False,
+    )
+
+
+def pytest_rejects_dtype_mismatch():
+    e = _expect(
+        "dtype-mismatch",
+        lambda c: c["NeuralNetwork"]["Architecture"].__setitem__(
+            "compute_dtype", "int8"
+        ),
+        deep=False,
+    )
+    assert "floating" in str(e)
+    _expect(
+        "dtype-mismatch",
+        lambda c: c["NeuralNetwork"]["Architecture"].__setitem__(
+            "compute_dtype", "not-a-dtype"
+        ),
+        deep=False,
+    )
+
+
+def pytest_rejects_oob_bucket():
+    _expect(
+        "oob-bucket",
+        lambda c: c["NeuralNetwork"]["Training"].__setitem__("batch_size", 0),
+        deep=False,
+    )
+    _expect(
+        "oob-bucket",
+        lambda c: c["Dataset"].__setitem__("num_buckets", -2),
+        deep=False,
+    )
+    # Serving ladder that cannot fit the model's graph size.
+    config = _base()
+    config["NeuralNetwork"]["Architecture"].update(
+        input_dim=1,
+        output_dim=[1, 1, 1, 1],
+        output_type=["graph", "node", "node", "node"],
+        num_nodes=100,
+    )
+    with pytest.raises(ConfigContractError) as err:
+        check_config(
+            config, mode="serving", bucket_ladder=[(64, 256)], deep=False
+        )
+    assert [c for c, _ in err.value.errors] == ["oob-bucket"]
+    assert "cannot fit" in str(err.value)
+
+
+def pytest_rejects_missing_dataset_field():
+    e = _expect("missing-field", lambda c: c["Dataset"].pop("name"), deep=False)
+    assert "Dataset.name" in str(e)
+    _expect("missing-field", lambda c: c.pop("Dataset"), deep=False)
+    _expect(
+        "missing-field",
+        lambda c: c["Dataset"].pop("node_features"),
+        deep=False,
+    )
+
+
+def pytest_rejects_donation_misuse():
+    e = _expect(
+        "donation-misuse",
+        lambda c: c["NeuralNetwork"]["Training"].update(
+            optimizer="LBFGS", graph_axis=2
+        ),
+        deep=False,
+    )
+    assert "LBFGS" in str(e)
+
+
+def pytest_rejects_shape_mismatch_via_eval_shape():
+    """The eval_shape half: a head-spec error only visible when the full
+    model+loss+step actually traces (unknown node head type) is caught
+    statically, with the model's own actionable message."""
+    config = _base()
+    config["NeuralNetwork"]["Architecture"]["output_heads"]["node"][
+        "type"
+    ] = "bogus"
+    with pytest.raises(ConfigContractError) as err:
+        check_config(config, mode="training")
+    assert any(c == "shape-mismatch" for c, _ in err.value.errors)
+    assert "Unknown node head type" in str(err.value)
+
+
+def pytest_rejects_edge_features_on_non_edge_model():
+    _expect(
+        "bad-arch",
+        lambda c: c["NeuralNetwork"]["Architecture"].update(
+            model_type="GIN", edge_features=["lengths"]
+        ),
+        deep=False,
+    )
+
+
+# ------------------------------------------------------------------ valid pass
+def pytest_valid_config_passes_without_device_compile():
+    """The committed CI config passes the FULL (eval_shape) check, and the
+    check itself performs zero XLA compilations — 'before any device
+    compile' is a measured property, not a promise."""
+    start = compile_count()
+    report = check_config(_CONFIG, mode="training", strict=False)
+    assert report["ok"], report["errors"]
+    assert report["eval_shape_s"] is not None
+    assert compile_count() == start
+
+
+def pytest_checker_is_cached_per_config():
+    import time as _time
+
+    check_config(_CONFIG, mode="training", strict=False)  # prime
+    t0 = _time.perf_counter()
+    report = check_config(_CONFIG, mode="training", strict=False)
+    assert report["ok"]
+    assert _time.perf_counter() - t0 < 0.25  # cache hit, no re-trace
+
+
+# ------------------------------------------------------------------------- CLI
+@pytest.mark.mpi_skip()
+def pytest_check_config_cli(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "hydragnn_tpu.analysis",
+            "check-config",
+            _CONFIG,
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    doc = json.loads(ok.stdout)
+    assert doc["ok"] and doc["mode"] == "training"
+
+    broken = _base()
+    del broken["Dataset"]["name"]
+    bad_path = str(tmp_path / "broken.json")
+    with open(bad_path, "w") as f:
+        json.dump(broken, f)
+    bad = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "hydragnn_tpu.analysis",
+            "check-config",
+            bad_path,
+        ],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        env=env,
+    )
+    assert bad.returncode == 1
+    assert "[missing-field]" in bad.stdout and "Dataset.name" in bad.stdout
+
+
+# ------------------------------------------------------------ entry-point gate
+def pytest_run_training_rejects_broken_config():
+    """run_training refuses a broken config at the top — before data loading
+    touches the filesystem, before any compile."""
+    import hydragnn_tpu
+
+    config = _base()
+    config["NeuralNetwork"]["Architecture"]["task_weights"] = [1.0]
+    with pytest.raises(ConfigContractError, match="task_weights"):
+        hydragnn_tpu.run_training(config)
+
+
+def pytest_serving_mode_requires_completed_config():
+    with pytest.raises(ConfigContractError, match="COMPLETED"):
+        check_config(_base(), mode="serving", deep=False)

@@ -1,0 +1,473 @@
+"""graftlint rule coverage: one positive fixture (seeded violation is caught,
+with the right rule ID and file:line) and one negative fixture (the idiomatic
+pattern passes) per rule, plus the suppression-requires-reason policy, the
+baseline mechanics, and the recompile sentinel.
+
+Fixture files are written under tmp_path and linted with ``lint_paths`` —
+the same engine ``python -m hydragnn_tpu.analysis`` runs over the repo
+(tests/test_lint_clean.py locks THAT invocation's cleanliness)."""
+
+import os
+import textwrap
+
+import pytest
+
+from hydragnn_tpu.analysis import (
+    lint_paths,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+
+
+def _lint_file(tmp_path, source, relname="mod.py"):
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_paths([str(tmp_path)], root=str(tmp_path))
+
+
+def _rules_at(report):
+    return {(v.rule, v.path, v.line) for v in report.violations}
+
+
+# ------------------------------------------------------------ host-sync-in-step
+def pytest_host_sync_positive(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            a = np.asarray(x)
+            b = x.item()
+            return float(x) + a + b
+        """,
+    )
+    got = {(v.rule, v.line) for v in report.violations}
+    assert ("host-sync-in-step", 7) in got  # np.asarray
+    assert ("host-sync-in-step", 8) in got  # .item()
+    assert ("host-sync-in-step", 9) in got  # float()
+    assert all(v.path == "mod.py" for v in report.violations)
+
+
+def pytest_host_sync_reaches_through_calls(tmp_path):
+    """A helper REACHABLE from a jitted root is step code even without its
+    own decorator — the reachability half of the rule."""
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+
+        def helper(x):
+            return jax.device_get(x)
+
+        @jax.jit
+        def outer(x):
+            return helper(x)
+        """,
+    )
+    assert [(v.rule, v.qualname) for v in report.violations] == [
+        ("host-sync-in-step", "helper")
+    ]
+
+
+def pytest_host_sync_negative(tmp_path):
+    """Host code may sync freely; traced code may use jnp and static shape
+    metadata (float(x.shape[0]) is trace-time static, not a sync)."""
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def host_reporting(x):
+            return float(np.asarray(x).mean())
+
+        @jax.jit
+        def step(x):
+            scale = float(x.shape[0])
+            return jnp.asarray(x) * scale
+        """,
+    )
+    assert report.violations == []
+
+
+# ---------------------------------------------------------------- cond-in-guard
+def pytest_cond_in_guard_positive(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def _all_finite(loss, grads):
+            return jnp.isfinite(loss)
+
+        def _step_body(model, opt):
+            def body(state, batch):
+                ok = _all_finite(1.0, state)
+                new = lax.cond(ok, lambda: state, lambda: batch)
+                if ok:
+                    new = state
+                return new
+            return body
+        """,
+        relname="train/trainer.py",
+    )
+    got = {(v.rule, v.line) for v in report.violations}
+    assert ("cond-in-guard", 12) in got  # lax.cond
+    assert ("cond-in-guard", 13) in got  # if ok:
+
+
+def pytest_cond_in_guard_negative(tmp_path):
+    """The shipped idiom — jnp.where select over the all-finite flag — is
+    exactly what the rule must NOT flag."""
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _all_finite(loss, grads):
+            ok = jnp.isfinite(loss)
+            for g in grads:
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+            return ok
+
+        def _keep_if(ok, new_tree, old_tree):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+            )
+
+        def _step_body(model, opt, guard=False):
+            def body(state, batch):
+                ok = _all_finite(1.0, [state])
+                if guard:
+                    state = _keep_if(ok, state, batch)
+                return jnp.where(ok, state, batch)
+            return body
+        """,
+        relname="train/trainer.py",
+    )
+    assert report.violations == []
+
+
+# -------------------------------------------------------------- use-after-donate
+def pytest_use_after_donate_positive(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def run():
+            f = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+            s = jnp.zeros(3)
+            out = f(s, jnp.ones(3))
+            return s + out
+        """,
+    )
+    assert [(v.rule, v.line) for v in report.violations] == [
+        ("use-after-donate", 9)
+    ]
+
+
+def pytest_use_after_donate_negative(tmp_path):
+    """Rebinding the donated name from the call's result — the driver's
+    ``state, m = step(state, ...)`` idiom — is the correct pattern."""
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def run():
+            f = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+            s = jnp.zeros(3)
+            for _ in range(4):
+                s = f(s, jnp.ones(3))
+            return s
+        """,
+    )
+    assert report.violations == []
+
+
+def pytest_use_after_donate_factory(tmp_path):
+    """The framework factories (make_train_step etc.) donate position 0 even
+    though the jit call is inside the factory — framework knowledge."""
+    report = _lint_file(
+        tmp_path,
+        """
+        from hydragnn_tpu.train.trainer import make_train_step
+
+        def run(model, opt, state, batch, rng):
+            step = make_train_step(model, opt)
+            new_state, m = step(state, batch, rng)
+            return state, new_state
+        """,
+    )
+    assert [(v.rule, v.line) for v in report.violations] == [
+        ("use-after-donate", 7)
+    ]
+
+
+# -------------------------------------------------------------- recompile-hazard
+def pytest_recompile_hazard_positive(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        TABLE = jnp.arange(8)
+
+        def loopy(xs):
+            total = 0
+            for x in xs:
+                g = jax.jit(lambda y: y * 2)
+                total += g(x)
+            return total
+
+        def unhashable():
+            f = jax.jit(lambda a, b: b, static_argnums=(0,))
+            return f([1, 2], 3.0)
+        """,
+    )
+    got = {(v.rule, v.line) for v in report.violations}
+    assert ("recompile-hazard", 5) in got  # jnp at import time
+    assert ("recompile-hazard", 10) in got  # jit inside loop
+    assert ("recompile-hazard", 16) in got  # unhashable static arg
+    assert len(got) == 3
+
+
+def pytest_recompile_hazard_negative(tmp_path):
+    """Module-scope jit BINDING (no jnp work) and AOT .lower().compile()
+    reuse inside a warmup loop are both fine."""
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        _copy = jax.jit(lambda xs: [x for x in xs])
+
+        def warmup(jitted, shapes):
+            exes = []
+            for s in shapes:
+                exes.append(jitted.lower(jnp_zeros(s)).compile())
+            return exes
+
+        def jnp_zeros(s):
+            return jnp.zeros(s)
+
+        def static_ok():
+            f = jax.jit(lambda a, b: b, static_argnums=(0,))
+            return f((1, 2), 3.0)
+        """,
+    )
+    assert report.violations == []
+
+
+# ----------------------------------------------------------------- nondeterminism
+def pytest_nondeterminism_positive(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        import time
+        import numpy as np
+
+        def shuffle_batch(idx):
+            np.random.shuffle(idx)
+            return idx, time.time()
+        """,
+        relname="graphs/collate.py",
+    )
+    got = {(v.rule, v.line) for v in report.violations}
+    assert ("nondeterminism", 6) in got  # np.random.shuffle
+    assert ("nondeterminism", 7) in got  # time.time entropy
+    report2 = _lint_file(
+        tmp_path,
+        """
+        import jax, time
+
+        @jax.jit
+        def step(x):
+            return x * time.perf_counter()
+        """,
+        relname="traced.py",
+    )
+    assert ("nondeterminism", 6) in {
+        (v.rule, v.line) for v in report2.violations if v.path == "traced.py"
+    }
+
+
+def pytest_nondeterminism_negative(tmp_path):
+    """Seeded generators and timing metrics in host collation code are the
+    shipped idiom (preprocess/dataloader.py) — not entropy."""
+    report = _lint_file(
+        tmp_path,
+        """
+        import time
+        import numpy as np
+
+        def shard(seed, epoch, idx):
+            order = np.random.default_rng(seed + epoch).permutation(len(idx))
+            t0 = time.perf_counter()
+            return idx[order], time.perf_counter() - t0
+        """,
+        relname="preprocess/dataloader.py",
+    )
+    assert report.violations == []
+
+
+# ------------------------------------------------------------------- suppression
+def pytest_suppression_requires_reason(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def a(x):
+        return np.asarray(x)  # graftlint: disable=host-sync-in-step
+
+    @jax.jit
+    def b(x):
+        return np.asarray(x)  # graftlint: disable=host-sync-in-step(trace-time constant fold, measured)
+    """
+    report = _lint_file(tmp_path, src)
+    rules = sorted(v.rule for v in report.violations)
+    # a(): the bare suppression does NOT suppress AND is itself flagged.
+    assert rules == ["host-sync-in-step", "suppression-without-reason"]
+    # b(): suppressed, with the justification carried in the report.
+    assert len(report.suppressed) == 1
+    assert report.suppressed[0].reason == "trace-time constant fold, measured"
+
+
+def pytest_suppression_unknown_rule(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        X = 1  # graftlint: disable=not-a-rule(whatever)
+        """,
+    )
+    assert [v.rule for v in report.violations] == ["suppression-without-reason"]
+    assert "unknown rule" in report.violations[0].message
+
+
+# ---------------------------------------------------------------------- baseline
+def pytest_baseline_tolerates_then_catches_new(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    TABLE = jnp.arange(8)
+    """
+    report = _lint_file(tmp_path, src)
+    assert [v.rule for v in report.violations] == ["recompile-hazard"]
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(report, bl_path)
+    baseline = load_baseline(bl_path)
+    assert new_violations(report, baseline) == []
+    # A SECOND instance of the same key exceeds the baselined count.
+    report2 = _lint_file(tmp_path, src + "TABLE2 = jnp.arange(9)\n")
+    fresh = new_violations(report2, baseline)
+    assert len(fresh) == 1 and fresh[0].rule == "recompile-hazard"
+
+
+def pytest_baseline_refuses_never_grandfathered(tmp_path):
+    report = _lint_file(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.asarray(x)
+        """,
+    )
+    with pytest.raises(ValueError, match="never grandfathered"):
+        save_baseline(report, str(tmp_path / "baseline.json"))
+
+
+def pytest_repo_baseline_is_empty_for_critical_rules():
+    """ISSUE 4 satellite: the committed baseline must be empty for
+    host-sync-in-step and cond-in-guard (load_baseline raises otherwise),
+    and — stronger, the shipped state — empty entirely."""
+    baseline = load_baseline()
+    assert baseline == {}
+
+
+# ---------------------------------------------------------------------- sentinel
+def pytest_no_recompile_sentinel():
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.analysis import RecompileError, no_recompile
+
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones(3))  # warm
+    with no_recompile(label="warm replay") as watch:
+        f(jnp.ones(3))
+    assert watch.count == 0
+    with pytest.raises(RecompileError, match="cold shape"):
+        with no_recompile(label="cold shape"):
+            f(jnp.ones(5))
+    # AOT .lower().compile() counts too (the serve engine's compile path).
+    x7 = jnp.ones(7)  # materialize OUTSIDE the watch (ones() itself compiles)
+    with no_recompile(action="count") as watch:
+        f.lower(x7).compile()
+    assert watch.count == 1
+
+
+def pytest_engine_no_recompile_contract():
+    """The serve engine's generalized accounting: steady traffic after
+    warmup stays at zero XLA compiles (the context manager raises if not)."""
+    import numpy as np
+
+    from hydragnn_tpu.graphs.sample import GraphSample
+    from hydragnn_tpu.models import init_model_variables
+    from hydragnn_tpu.models.create import create_model, make_example_batch
+    from hydragnn_tpu.serve.engine import InferenceEngine
+
+    model = create_model(
+        model_type="GIN",
+        input_dim=1,
+        hidden_dim=4,
+        output_dim=[1],
+        output_type=["graph"],
+        output_heads={
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 4,
+                "num_headlayers": 1,
+                "dim_headlayers": [4],
+            }
+        },
+        task_weights=[1.0],
+        num_conv_layers=1,
+    )
+    variables = init_model_variables(
+        model, make_example_batch(1, [1], ["graph"])
+    )
+    sample = GraphSample(
+        x=np.ones((3, 1), np.float32),
+        pos=np.zeros((3, 3), np.float32),
+        edge_index=np.array([[0, 1, 2], [1, 2, 0]], np.int32),
+    )
+    with InferenceEngine(
+        model,
+        variables,
+        max_batch_graphs=2,
+        bucket_ladder=[(8, 8)],
+        warmup=True,
+    ) as engine:
+        engine.predict([sample])  # prime any one-off jit traffic (device_put)
+        with engine.no_recompile():
+            out = engine.predict([sample, sample])
+        assert len(out) == 2
