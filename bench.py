@@ -494,6 +494,7 @@ def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
             "drills_total": doc.get("drills_total"),
             "guard_overhead_pct": doc.get("guard_overhead_pct"),
             "guard_bit_inert": doc.get("guard_bit_inert"),
+            "ckpt_save_stall_ms": doc.get("ckpt_save_stall_ms"),
         }
 
     return _latest_artifact_block("FAULTS_*.json", extract, search_dir)

@@ -1,0 +1,81 @@
+"""Verified, asynchronous, self-healing checkpointing (docs/CHECKPOINTING.md).
+
+Three pillars:
+
+* :mod:`.format` — the v2 integrity-checked container: msgpack (never pickle
+  on load), a header with format version / epoch / param-tree fingerprint,
+  and per-section sha256 digests verified on every load. v1 pickle files
+  remain readable through a deprecation window.
+* :mod:`.io` — atomic writes with writer-owned unique tmp names, keep_last_k
+  retention, and the corruption fallback chain: a torn/bit-flipped latest
+  checkpoint falls back to the newest intact retained entry, recorded in
+  ``FaultCounters`` and ``supervisor.json``, failing only when the whole
+  chain is exhausted.
+* :mod:`.async_writer` — non-blocking saves: device→host snapshot on the
+  training thread, serialize/fsync/rename on a single background writer,
+  ``wait()`` barriers at the next save and run exit, writer failures
+  re-raised rather than swallowed.
+
+``utils/model.py`` keeps the historical public names (``save_model``,
+``load_existing_model``, ...) as thin wrappers over this package.
+
+CLI: ``python -m hydragnn_tpu.checkpoint {verify,migrate} <run_dir>``.
+"""
+
+from .async_writer import AsyncCheckpointer
+from .format import (
+    FORMAT_VERSION,
+    MAGIC,
+    MIGRATE_CMD,
+    CheckpointChainExhaustedError,
+    CheckpointCorruptError,
+    CheckpointError,
+    param_fingerprint,
+)
+from .io import (
+    atomic_write_json,
+    checkpoint_exists,
+    cleanup_stale_checkpoint_tmp,
+    load_checkpoint_file,
+    load_checkpoint_manifest,
+    load_checkpoint_meta,
+    load_existing_model,
+    load_existing_model_config,
+    load_verified_chain,
+    migrate_checkpoint,
+    migrate_run_dir,
+    record_checkpoint_fallback,
+    save_model,
+    serialize_checkpoint,
+    set_post_save_hook,
+    update_checkpoint_meta,
+    verify_checkpoint_file,
+)
+
+__all__ = [
+    "FORMAT_VERSION",
+    "MAGIC",
+    "MIGRATE_CMD",
+    "AsyncCheckpointer",
+    "atomic_write_json",
+    "CheckpointChainExhaustedError",
+    "CheckpointCorruptError",
+    "CheckpointError",
+    "checkpoint_exists",
+    "cleanup_stale_checkpoint_tmp",
+    "load_checkpoint_file",
+    "load_checkpoint_manifest",
+    "load_checkpoint_meta",
+    "load_existing_model",
+    "load_existing_model_config",
+    "load_verified_chain",
+    "migrate_checkpoint",
+    "migrate_run_dir",
+    "param_fingerprint",
+    "record_checkpoint_fallback",
+    "save_model",
+    "serialize_checkpoint",
+    "set_post_save_hook",
+    "update_checkpoint_meta",
+    "verify_checkpoint_file",
+]

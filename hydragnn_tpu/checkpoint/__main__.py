@@ -1,0 +1,82 @@
+"""Checkpoint operations CLI (docs/CHECKPOINTING.md "Migration")::
+
+    python -m hydragnn_tpu.checkpoint verify  <run_dir | file.pk> [--json]
+    python -m hydragnn_tpu.checkpoint migrate <run_dir | file.pk> [--json]
+
+``verify`` integrity-checks every checkpoint (v2 digest verification, v1
+structural decode) and exits nonzero if any file fails — the preflight an
+operator runs before trusting a copied-around run directory. ``migrate``
+rewrites v1 pickle checkpoints as v2 in place (atomic); corrupt files are
+reported and left untouched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List
+
+from .io import migrate_run_dir, verify_checkpoint_file
+
+
+def _targets(path: str) -> List[str]:
+    if os.path.isdir(path):
+        return sorted(glob.glob(os.path.join(path, "*.pk")))
+    return [path]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.checkpoint",
+        description="Verify or migrate hydragnn_tpu checkpoints.",
+    )
+    ap.add_argument("command", choices=("verify", "migrate"))
+    ap.add_argument("path", help="run directory (logs/<name>) or one .pk file")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.command == "verify":
+        reports = [verify_checkpoint_file(p) for p in _targets(args.path)]
+        bad = [r for r in reports if not r["ok"]]
+        if args.json:
+            print(json.dumps({"reports": reports, "ok": not bad}))
+        else:
+            for r in reports:
+                status = (
+                    f"ok (v{r['format_version']}, epoch {r.get('epoch')})"
+                    if r["ok"]
+                    else f"CORRUPT: {r['error']}"
+                )
+                print(f"{r['file']}: {status}")
+        return 1 if bad or not reports else 0
+
+    result: dict
+    if os.path.isdir(args.path):
+        result = migrate_run_dir(args.path)
+    else:
+        from .io import migrate_checkpoint
+
+        try:
+            migrated = migrate_checkpoint(args.path)
+            result = {
+                "migrated": [args.path] if migrated else [],
+                "already_v2": [] if migrated else [args.path],
+                "failed": [],
+            }
+        except Exception as e:
+            result = {"migrated": [], "already_v2": [], "failed": [args.path],
+                      "error": str(e)}
+    if args.json:
+        print(json.dumps(result))
+    else:
+        for key in ("migrated", "already_v2", "failed"):
+            for p in result[key]:
+                print(f"{key}: {p}")
+    return 1 if result["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Non-blocking checkpointing (docs/CHECKPOINTING.md "Async lifecycle").
+
+The synchronous ``save_model`` holds the training thread through serialize +
+fsync + rename — tens to hundreds of milliseconds the accelerator sits idle
+every checkpoint epoch. :class:`AsyncCheckpointer` splits the save at the
+only point that NEEDS the training thread: the device→host snapshot.
+
+Lifecycle per ``save()`` call (training thread):
+
+1. ``wait()`` — barrier on the PREVIOUS save (bounded in-flight of one write;
+   also where a prior writer failure re-raises, so errors are never swallowed
+   more than one save interval).
+2. Device→host snapshot of params/batch_stats/opt_state (``np.asarray`` per
+   leaf) + a deep copy of ``meta`` (the caller keeps mutating its history
+   dict between epochs).
+3. Enqueue for the single daemon writer thread, which runs the SAME
+   ``io.save_model`` implementation as a sync save — serialize, fsync,
+   atomic rename, retention, post-save fault hook. Sync and async payloads
+   are byte-identical by construction (one serializer).
+
+``wait()`` at run exit (or ``close()``) drains the queue and re-raises any
+writer failure; a checkpoint that failed to persist must fail the run, not
+vanish into a dead thread.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import io as ckpt_io
+
+
+class AsyncCheckpointer:
+    """Single-writer asynchronous checkpoint front end. One instance per run;
+    the writer thread is lazily started and torn down by ``close()``."""
+
+    def __init__(self, max_inflight: int = 1):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, int(max_inflight)))
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------- internals
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name="ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            try:
+                ckpt_io.save_model(**job)
+            except BaseException as e:  # re-raised on the training thread
+                with self._lock:
+                    self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                "async checkpoint writer failed; the last checkpoint was NOT "
+                "persisted"
+            ) from err
+
+    # ----------------------------------------------------------------- api
+    def save(
+        self,
+        variables: Dict[str, Any],
+        opt_state: Any,
+        name: str,
+        path: str = "./logs/",
+        meta: Optional[Dict[str, Any]] = None,
+        keep_last_k: int = 0,
+    ) -> float:
+        """Snapshot + enqueue; returns the training-thread stall in seconds
+        (the whole point of the async path — compare against a sync save's
+        wall time, ``ckpt_save_stall_ms`` in the FAULTS artifact)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        if not ckpt_io._is_rank_zero():
+            return 0.0
+        t0 = time.perf_counter()
+        # Barrier at the next save: previous write complete (or its failure
+        # raised HERE, at the first wait point after it happened).
+        self.wait()
+        host_vars = {
+            "params": _to_host(variables["params"]),
+            "batch_stats": _to_host(variables.get("batch_stats", {})),
+        }
+        host_opt = _to_host(opt_state) if opt_state is not None else None
+        job = {
+            "variables": host_vars,
+            "opt_state": host_opt,
+            "name": name,
+            "path": path,
+            "meta": copy.deepcopy(meta),
+            "keep_last_k": keep_last_k,
+        }
+        self._ensure_thread()
+        self._queue.put(job)
+        from ..faults import FaultCounters
+
+        FaultCounters.inc("ckpt_async_saves")
+        return time.perf_counter() - t0
+
+    def wait(self) -> None:
+        """Drain every queued write, then re-raise any writer failure."""
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Flush and stop the writer. ``raise_errors=False`` is for exception
+        paths where a writer failure must not mask the original error."""
+        if self._closed:
+            return
+        try:
+            if raise_errors:
+                self.wait()
+            else:
+                self._queue.join()
+        finally:
+            self._closed = True
+            if self._thread is not None and self._thread.is_alive():
+                self._queue.put(None)
+                self._thread.join(timeout=10.0)
+
+
+def _to_host(tree):
+    """Device→host snapshot: every array leaf becomes host numpy NOW, so the
+    donating train step can reuse the device buffers the moment save()
+    returns. Non-array leaves (step counts, None) pass through."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf) if hasattr(leaf, "shape") else leaf, tree
+    )

@@ -1,0 +1,202 @@
+"""Checkpoint container format v2 — integrity-checked msgpack
+(docs/CHECKPOINTING.md "Format").
+
+A v2 checkpoint is ``MAGIC`` followed by one msgpack map::
+
+    {
+      "format_version": 2,
+      "header": {
+        "format_version": 2,
+        "epoch": <int|None>,          # from the save's meta, for cheap triage
+        "step": <int|None>,
+        "param_fingerprint": <hex>,   # sha256 over the param-tree structure
+        "sections": [<name>, ...],
+      },
+      "digests":  {<section>: <sha256 hex>, ...},
+      "sections": {<section>: <bytes>, ...},
+    }
+
+Sections are opaque byte blobs (``flax.serialization.to_bytes`` for
+params/batch_stats/opt_state, msgpack for meta). Every load recomputes each
+section's sha256 and compares against ``digests`` — a bit-flip, a torn write,
+or a truncation surfaces as :class:`CheckpointCorruptError` BEFORE any
+deserializer touches the bytes. The container itself is msgpack, never
+pickle: loading a v2 checkpoint executes no code.
+
+The encoding is deliberately wall-clock-free (timestamps live in the
+retention manifest, not the file): serializing the same state twice — or
+once synchronously and once through the async writer — produces identical
+bytes, which the async/sync byte-identity tests assert.
+
+v1 files (the legacy pickle payload) are detected by the absence of
+``MAGIC``; read-compat lives in :mod:`.io`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+MAGIC = b"HGNN2\x00"
+FORMAT_VERSION = 2
+
+#: The one-line migration command named by the v1 deprecation warning and
+#: the corruption-triage docs.
+MIGRATE_CMD = "python -m hydragnn_tpu.checkpoint migrate <logs/run_dir>"
+
+
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint-subsystem failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint file failed integrity verification (bad magic, torn or
+    truncated container, per-section digest mismatch, undecodable legacy
+    pickle). The fallback chain treats exactly this class as 'try the next
+    retained entry'."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+class CheckpointChainExhaustedError(CheckpointError):
+    """Every candidate in the fallback chain (latest + all retained entries)
+    failed verification. Carries the per-file failure list for the loud
+    final error the supervisor surfaces."""
+
+    def __init__(self, run_dir: str, failures: List[Dict[str, str]]):
+        detail = "; ".join(f"{f['file']}: {f['reason']}" for f in failures)
+        super().__init__(
+            f"checkpoint fallback chain exhausted in {run_dir} "
+            f"({len(failures)} candidate(s) failed): {detail}"
+        )
+        self.run_dir = run_dir
+        self.failures = failures
+
+
+def _msgpack_default(obj):
+    """Meta dicts may carry numpy scalars/arrays (loss history, scheduler
+    state); coerce them to plain types so meta stays msgpack-only."""
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"meta value of type {type(obj).__name__} is not msgpack-encodable")
+
+
+def pack_meta(meta: Optional[Dict[str, Any]]) -> bytes:
+    return msgpack.packb(meta or {}, use_bin_type=True, default=_msgpack_default)
+
+
+def unpack_meta(blob: bytes) -> Dict[str, Any]:
+    return msgpack.unpackb(blob, raw=False, strict_map_key=False) or {}
+
+
+def param_fingerprint(params) -> str:
+    """sha256 over the param tree's STRUCTURE (key paths, shapes, dtypes) —
+    cheap to compute from a template without touching weight bytes. A
+    mismatch means the checkpoint belongs to a different model/config, which
+    is an operator error, not corruption: the fallback chain does NOT mask
+    it (every retained entry would mismatch identically)."""
+    import jax
+
+    paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    desc = ";".join(
+        f"{jax.tree_util.keystr(kp)}:{tuple(getattr(leaf, 'shape', ()))}"
+        f":{getattr(leaf, 'dtype', '?')}"
+        for kp, leaf in paths
+    )
+    return hashlib.sha256(desc.encode()).hexdigest()
+
+
+def encode(
+    sections: Dict[str, Optional[bytes]], header: Optional[Dict[str, Any]] = None
+) -> bytes:
+    """Serialize sections into the v2 container. ``None`` sections (an
+    absent opt_state) are dropped, matching the v1 payload's ``None``.
+    The header is stored as its own msgpack blob with a digest of its own,
+    so EVERY meaningful region of the file is integrity-protected: a flip in
+    a section trips that section's digest, a flip in the header blob trips
+    the header digest, and a flip in the container framing itself makes the
+    outer msgpack undecodable — all three surface as
+    :class:`CheckpointCorruptError`, never as silently-altered state."""
+    present = {k: v for k, v in sections.items() if v is not None}
+    head = dict(header or {})
+    head["format_version"] = FORMAT_VERSION
+    head["sections"] = sorted(present)
+    header_blob = msgpack.packb(head, use_bin_type=True, default=_msgpack_default)
+    digests = {k: hashlib.sha256(v).hexdigest() for k, v in present.items()}
+    digests["__header__"] = hashlib.sha256(header_blob).hexdigest()
+    doc = {
+        "format_version": FORMAT_VERSION,
+        "header": header_blob,
+        "digests": digests,
+        "sections": present,
+    }
+    return MAGIC + msgpack.packb(doc, use_bin_type=True, default=_msgpack_default)
+
+
+def is_v2_blob(head: bytes) -> bool:
+    return head[: len(MAGIC)] == MAGIC
+
+
+def decode(
+    blob: bytes, path: str = "<bytes>", verify: bool = True
+) -> Tuple[Dict[str, Any], Dict[str, bytes]]:
+    """Parse + verify a v2 container → (header, sections). Raises
+    :class:`CheckpointCorruptError` on bad magic, an unparseable/truncated
+    container, a missing digest, or any digest mismatch."""
+    if not is_v2_blob(blob):
+        raise CheckpointCorruptError(path, "bad magic (not a v2 checkpoint)")
+    try:
+        doc = msgpack.unpackb(blob[len(MAGIC):], raw=False, strict_map_key=False)
+    except Exception as e:  # truncated/torn container
+        raise CheckpointCorruptError(
+            path, f"container undecodable ({type(e).__name__}: {e})"
+        ) from e
+    if not isinstance(doc, dict) or "sections" not in doc:
+        raise CheckpointCorruptError(path, "container missing sections map")
+    sections = doc["sections"]
+    digests = doc.get("digests") or {}
+    header_blob = doc.get("header") or b""
+    if verify:
+        checks = dict(sections)
+        checks["__header__"] = header_blob
+        for name, payload in checks.items():
+            want = digests.get(name)
+            if want is None:
+                raise CheckpointCorruptError(path, f"section {name!r} has no digest")
+            got = hashlib.sha256(payload).hexdigest()
+            if got != want:
+                raise CheckpointCorruptError(
+                    path,
+                    f"digest mismatch in section {name!r} "
+                    f"(stored {want[:12]}…, computed {got[:12]}…)",
+                )
+    try:
+        header = msgpack.unpackb(header_blob, raw=False, strict_map_key=False) or {}
+    except Exception as e:
+        raise CheckpointCorruptError(
+            path, f"header undecodable ({type(e).__name__}: {e})"
+        ) from e
+    # Version authority is the DIGEST-VERIFIED header copy, never the outer
+    # framing field (which no digest covers — a flipped byte there must not
+    # masquerade as a too-new file and bypass the fallback chain; the outer
+    # copy is advisory/fast-sniff only). Reaching here means the digests
+    # verified, so a too-new version is a genuine, intact newer file: fail
+    # loudly (upgrade, don't silently lose epochs to a fallback walk).
+    version = header.get("format_version")
+    if not isinstance(version, int) or not (1 <= version <= FORMAT_VERSION):
+        raise CheckpointError(
+            f"{path}: format_version {version!r} is outside this build's "
+            f"supported range [1, {FORMAT_VERSION}] — upgrade hydragnn_tpu "
+            "to load it"
+        )
+    return header, sections

@@ -1,0 +1,603 @@
+"""Checkpoint read/write, retention, and the corruption fallback chain
+(docs/CHECKPOINTING.md).
+
+This module absorbed the checkpoint half of ``utils/model.py`` (which keeps
+the public names as thin wrappers). Three contracts live here:
+
+* **Write**: one serializer (:func:`serialize_checkpoint`, v2 container) feeds
+  both the synchronous :func:`save_model` and the async writer — sync and
+  async saves of the same state are byte-identical. Writes are tmp + fsync +
+  ``os.replace`` with WRITER-OWNED unique tmp names (pid + sequence), so a
+  concurrent async writer and a foreign process can never collide on a tmp
+  path, and save entry never deletes anyone else's tmp (stale-tmp cleanup is
+  scoped to run startup, where no write can be in flight).
+
+* **Verified read**: :func:`load_checkpoint_file` sniffs v2 (magic) vs v1
+  (legacy pickle). v2 loads verify every section digest before any
+  deserializer runs; v1 read-compat survives but emits a one-time
+  ``DeprecationWarning`` naming the migration command.
+
+* **Fallback chain**: :func:`load_verified_chain` tries the latest file, then
+  walks the ``keep_last_k`` manifest newest→oldest, loading the first intact
+  entry. Every corrupt candidate increments ``FaultCounters`` and the
+  successful fallback is recorded in the run's ``supervisor.json``
+  (``checkpoint_fallbacks``: which file, why, how many epochs lost). Only an
+  exhausted chain raises.
+"""
+
+from __future__ import annotations
+
+import glob
+import itertools
+import json
+import os
+import pickle
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flax import serialization
+
+from . import format as ckpt_format
+from .format import (
+    MIGRATE_CMD,
+    CheckpointChainExhaustedError,
+    CheckpointCorruptError,
+    CheckpointError,
+)
+
+
+def _is_rank_zero() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+# Writer-owned unique tmp names: <final>.<pid>.<seq>.tmp — two writers (the
+# async thread plus a stray sync save, or two processes on shared storage)
+# can never collide, and cleanup never has to guess whether a tmp is live.
+_tmp_seq = itertools.count()
+
+
+def _unique_tmp(path_name: str) -> str:
+    return f"{path_name}.{os.getpid()}.{next(_tmp_seq)}.tmp"
+
+
+def atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
+    """THE atomic JSON install (unique tmp + fsync + rename) for every
+    checkpoint-adjacent sidecar — retention manifest, supervisor.json. One
+    implementation so the sidecars carry the same durability contract as the
+    checkpoints they describe."""
+    tmp = _unique_tmp(path)
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def cleanup_stale_checkpoint_tmp(run_dir: str) -> List[str]:
+    """Remove ``*.tmp`` files a crash left behind mid-``os.replace``. Scoped
+    to RUN STARTUP only (run_training bootstrap, supervisor entry) — at
+    startup no writer exists yet, so any ``.tmp`` present is by construction
+    a torn leftover. Never called at save entry: with the async writer a
+    ``.tmp`` there may be a LIVE in-flight write. Returns the removed paths
+    (logged by the fault drills)."""
+    removed = []
+    for p in glob.glob(os.path.join(run_dir, "*.tmp")):
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            pass
+    return removed
+
+
+# --------------------------------------------------------------------------
+# post-save fault hook (drills: corrupt_ckpt / truncate_ckpt / kill@save)
+# --------------------------------------------------------------------------
+
+_post_save_hook: Optional[Callable[[str], None]] = None
+
+
+def set_post_save_hook(hook: Optional[Callable[[str], None]]) -> None:
+    """Install (or clear, with ``None``) the callable invoked with the final
+    checkpoint path after every completed save — sync or async. The fault
+    plan's checkpoint drills (``corrupt_ckpt@K``/``truncate_ckpt@K``/
+    ``kill@saveK``) register here via the TrainingDriver."""
+    global _post_save_hook
+    _post_save_hook = hook
+
+
+# --------------------------------------------------------------- manifests
+
+
+def _manifest_path(run_dir: str, name: str) -> str:
+    return os.path.join(run_dir, name + ".manifest.json")
+
+
+def load_checkpoint_manifest(name: str, path: str = "./logs/") -> Dict[str, Any]:
+    """The retention manifest written by ``save_model(keep_last_k=...)``
+    ({} when retention was never enabled, or the manifest is torn)."""
+    try:
+        with open(_manifest_path(os.path.join(path, name), name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _retain_checkpoints(
+    run_dir: str, name: str, latest: str, keep_last_k: int, meta
+) -> None:
+    """keep_last_k retention: hard-link the just-written latest checkpoint to
+    an epoch-tagged retained file, prune retained files beyond k, and update
+    the manifest ATOMICALLY (unique tmp + os.replace) — a crash at any point
+    leaves either the old or the new manifest, both listing only files that
+    exist."""
+    epoch = (meta or {}).get("epoch")
+    try:
+        with open(_manifest_path(run_dir, name)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {}
+    entries = [
+        e
+        for e in manifest.get("entries", [])
+        if os.path.exists(os.path.join(run_dir, e["file"]))
+    ]
+    serial = (max((e.get("serial", 0) for e in entries), default=0)) + 1
+    tag = f"e{int(epoch):06d}" if epoch is not None else f"s{serial:06d}"
+    retained = f"{name}.{tag}.pk"
+    retained_path = os.path.join(run_dir, retained)
+    link_tmp = _unique_tmp(retained_path)
+    try:
+        os.link(latest, link_tmp)  # same content, no second serialization
+        os.replace(link_tmp, retained_path)
+    except OSError:
+        import shutil  # filesystems without hard links
+
+        shutil.copyfile(latest, link_tmp)
+        os.replace(link_tmp, retained_path)
+    entries = [e for e in entries if e["file"] != retained]
+    entries.append(
+        {
+            "file": retained,
+            "epoch": epoch,
+            "serial": serial,
+            "saved_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+    )
+    entries.sort(key=lambda e: e["serial"])
+    for drop in entries[:-keep_last_k] if keep_last_k > 0 else []:
+        try:
+            os.remove(os.path.join(run_dir, drop["file"]))
+        except OSError:
+            pass
+    entries = entries[-keep_last_k:] if keep_last_k > 0 else entries
+    doc = {"name": name, "keep_last_k": keep_last_k, "entries": entries}
+    atomic_write_json(_manifest_path(run_dir, name), doc)
+
+
+# ------------------------------------------------------------------- write
+
+
+def _canonical(tree):
+    """Identity tree_map: rebuilds every dict level in jax's canonical
+    (sorted) key order. flax serializes dicts in ITERATION order, so without
+    this a tree that went through a pytree transform (the async writer's
+    host snapshot) would serialize different bytes than the original
+    insertion-ordered dict — breaking the sync/async byte-identity
+    contract."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: x, tree)
+
+
+def serialize_checkpoint(
+    variables: Dict[str, Any],
+    opt_state: Any = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """THE checkpoint serializer: state → v2 container bytes. Shared by the
+    sync save path and the async writer thread, so the two cannot diverge —
+    the async/sync byte-identity test pins exactly this property."""
+    sections = {
+        "params": serialization.to_bytes(_canonical(variables["params"])),
+        "batch_stats": serialization.to_bytes(
+            _canonical(variables.get("batch_stats", {}))
+        ),
+        "opt_state": serialization.to_bytes(_canonical(opt_state))
+        if opt_state is not None
+        else None,
+        "meta": ckpt_format.pack_meta(meta),
+    }
+    header = {
+        "epoch": (meta or {}).get("epoch"),
+        "step": (meta or {}).get("step"),
+        "param_fingerprint": ckpt_format.param_fingerprint(variables["params"]),
+    }
+    return ckpt_format.encode(sections, header)
+
+
+def write_checkpoint_blob(path_name: str, blob: bytes) -> None:
+    """Durable atomic install: unique tmp → write → flush+fsync → rename. The
+    fsync is what makes the integrity story real — without it a crash after
+    os.replace can still leave a torn file on power loss."""
+    tmp_name = _unique_tmp(path_name)
+    with open(tmp_name, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_name, path_name)
+
+
+def save_model(
+    variables: Dict[str, Any],
+    opt_state: Any,
+    name: str,
+    path: str = "./logs/",
+    meta: Optional[Dict[str, Any]] = None,
+    keep_last_k: int = 0,
+) -> None:
+    """Rank-0 single-file checkpoint in the v2 verified format. ``meta``
+    carries training progress (epoch, scheduler state, loss history) so a
+    preempted run can resume exactly where it stopped (Training.resume).
+
+    ``keep_last_k > 0`` additionally retains the last k checkpoints as
+    epoch-tagged hard links next to the latest (``<name>.e000004.pk``) with an
+    atomically-updated ``<name>.manifest.json`` — the corruption fallback
+    chain walks exactly those entries. The ``<name>.pk`` latest-checkpoint
+    contract is unchanged either way."""
+    if not _is_rank_zero():
+        return
+    path_name = os.path.join(path, name, name + ".pk")
+    run_dir = os.path.dirname(path_name)
+    os.makedirs(run_dir, exist_ok=True)
+    blob = serialize_checkpoint(variables, opt_state, meta)
+    write_checkpoint_blob(path_name, blob)
+    if keep_last_k and keep_last_k > 0:
+        _retain_checkpoints(run_dir, name, path_name, int(keep_last_k), meta)
+    hook = _post_save_hook
+    if hook is not None:
+        hook(path_name)
+
+
+# -------------------------------------------------------------------- read
+
+_v1_warned = False
+
+
+def _warn_v1_once(path_name: str) -> None:
+    global _v1_warned
+    if _v1_warned:
+        return
+    _v1_warned = True
+    warnings.warn(
+        f"{path_name} is a legacy v1 pickle checkpoint (no integrity digests, "
+        f"pickle.load on arbitrary bytes). Migrate it with `{MIGRATE_CMD}`; "
+        "v1 read-compat will be removed after the migration window.",
+        DeprecationWarning,
+        stacklevel=3,
+    )
+
+
+def read_checkpoint_payload(path_name: str) -> Tuple[int, Dict[str, Any]]:
+    """Raw payload of one checkpoint file → (format_version, payload) where
+    payload is the v1-shaped dict {params: bytes, batch_stats: bytes,
+    opt_state: bytes|None, meta: dict, header: dict}. Integrity-verifies v2
+    digests; wraps every v1 pickle failure as CheckpointCorruptError so the
+    fallback chain can classify it."""
+    try:
+        with open(path_name, "rb") as f:
+            head = f.read(len(ckpt_format.MAGIC))
+            rest = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(path_name, f"unreadable ({e})") from e
+    blob = head + rest
+    if ckpt_format.is_v2_blob(blob):
+        header, sections = ckpt_format.decode(blob, path_name)
+        meta = (
+            ckpt_format.unpack_meta(sections["meta"]) if "meta" in sections else {}
+        )
+        payload = {
+            "params": sections.get("params"),
+            "batch_stats": sections.get("batch_stats"),
+            "opt_state": sections.get("opt_state"),
+            "meta": meta,
+            "header": header,
+        }
+        if payload["params"] is None:
+            raise CheckpointCorruptError(path_name, "missing params section")
+        return ckpt_format.FORMAT_VERSION, payload
+    # v1 legacy pickle. Any decode failure — truncation, a flipped byte in
+    # the pickle stream, a non-dict payload — is corruption.
+    try:
+        payload = pickle.loads(blob)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            path_name, f"v1 pickle undecodable ({type(e).__name__}: {e})"
+        ) from e
+    if not isinstance(payload, dict) or "params" not in payload:
+        raise CheckpointCorruptError(path_name, "v1 payload is not a checkpoint dict")
+    _warn_v1_once(path_name)
+    payload.setdefault("meta", {})
+    payload["meta"] = payload.get("meta") or {}
+    payload["header"] = {"format_version": 1}
+    return 1, payload
+
+
+def load_checkpoint_file(
+    variables: Dict[str, Any], path_name: str, opt_state: Any = None
+):
+    """Restore one checkpoint FILE onto a variables template. The single
+    deserialization implementation — the log-name convenience wrappers and
+    direct-path consumers (serve engine) share it, so a payload-schema change
+    cannot diverge them. Verifies v2 digests (and the param-tree fingerprint)
+    before deserializing; raises CheckpointCorruptError on integrity
+    failures. Returns (variables, opt_state, meta)."""
+    version, payload = read_checkpoint_payload(path_name)
+    fp = payload["header"].get("param_fingerprint")
+    if version >= 2 and fp:
+        want = ckpt_format.param_fingerprint(variables["params"])
+        if fp != want:
+            # Deliberately NOT CheckpointCorruptError: a wrong-model load is
+            # an operator error the fallback chain must not paper over.
+            raise CheckpointError(
+                f"{path_name}: param-tree fingerprint mismatch — this "
+                "checkpoint was saved from a different model/config than "
+                "the load template"
+            )
+    try:
+        new_vars = dict(variables)
+        new_vars["params"] = serialization.from_bytes(
+            variables["params"], payload["params"]
+        )
+        new_vars["batch_stats"] = serialization.from_bytes(
+            variables.get("batch_stats", {}), payload["batch_stats"]
+        )
+        if opt_state is not None and payload.get("opt_state") is not None:
+            opt_state = serialization.from_bytes(opt_state, payload["opt_state"])
+    except CheckpointError:
+        raise
+    except Exception as e:
+        # Digest-verified v2 sections should never land here; v1 sections
+        # have no digests, so undecodable msgpack inside them IS corruption.
+        raise CheckpointCorruptError(
+            path_name, f"section deserialization failed ({type(e).__name__}: {e})"
+        ) from e
+    return new_vars, opt_state, payload.get("meta") or {}
+
+
+def verify_checkpoint_file(path_name: str) -> Dict[str, Any]:
+    """Non-raising integrity report for one file (the ``verify`` CLI):
+    {file, ok, format_version?, epoch?, error?}."""
+    report: Dict[str, Any] = {"file": path_name}
+    try:
+        version, payload = read_checkpoint_payload(path_name)
+    except CheckpointError as e:
+        report.update(ok=False, error=str(e))
+        return report
+    report.update(
+        ok=True,
+        format_version=version,
+        epoch=(payload.get("meta") or {}).get("epoch"),
+    )
+    return report
+
+
+# -------------------------------------------------- corruption fallback chain
+
+
+def record_checkpoint_fallback(run_dir: str, event: Dict[str, Any]) -> None:
+    """Append a fallback event to the run's ``supervisor.json``
+    (``checkpoint_fallbacks`` list), creating the file if the run was never
+    supervised — restart tooling and the drill matrix read it either way.
+    Atomic read-modify-write; rank-0 callers only."""
+    path = os.path.join(run_dir, "supervisor.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc.setdefault("checkpoint_fallbacks", []).append(event)
+    atomic_write_json(path, doc)
+
+
+def load_verified_chain(
+    variables: Dict[str, Any],
+    run_dir: str,
+    name: str,
+    opt_state: Any = None,
+):
+    """The self-healing load: try ``<name>.pk``, then walk the ``keep_last_k``
+    manifest newest→oldest, returning the first intact checkpoint. Returns
+    (variables, opt_state, meta, report) where report is None for a clean
+    latest-file load and otherwise {fallback_file, failures, epochs_lost}.
+
+    Every corrupt candidate increments ``FaultCounters['ckpt_corrupt_detected']``;
+    a successful fallback increments ``ckpt_fallback_loads`` and is recorded
+    in the run's supervisor.json (rank 0). Raises
+    :class:`CheckpointChainExhaustedError` only when no candidate survives."""
+    from ..faults import FaultCounters
+
+    latest = os.path.join(run_dir, name + ".pk")
+    try:
+        with open(_manifest_path(run_dir, name)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {}
+    entries = sorted(
+        manifest.get("entries", []), key=lambda e: e.get("serial", 0), reverse=True
+    )
+    candidates: List[Tuple[str, Optional[Dict[str, Any]]]] = [(latest, None)]
+    for e in entries:
+        # The newest retained entry often hard-links the latest file — same
+        # inode, same (possibly corrupt) bytes. It is tried anyway: the try
+        # is cheap, the failure is counted honestly, and the chain keeps
+        # walking to the first genuinely intact entry.
+        candidates.append((os.path.join(run_dir, e["file"]), e))
+    failures: List[Dict[str, str]] = []
+    for path_name, entry in candidates:
+        if not os.path.exists(path_name):
+            failures.append({"file": path_name, "reason": "missing"})
+            continue
+        try:
+            new_vars, new_opt, meta = load_checkpoint_file(
+                variables, path_name, opt_state
+            )
+        except CheckpointCorruptError as e:
+            FaultCounters.inc("ckpt_corrupt_detected")
+            failures.append({"file": path_name, "reason": e.reason})
+            continue
+        if not failures:
+            return new_vars, new_opt, meta, None
+        # Fallback engaged: quantify the loss (epochs between the manifest's
+        # newest entry and what we actually recovered).
+        newest_epoch = next(
+            (e.get("epoch") for e in entries if e.get("epoch") is not None), None
+        )
+        got_epoch = meta.get("epoch")
+        epochs_lost = (
+            int(newest_epoch) - int(got_epoch)
+            if newest_epoch is not None and got_epoch is not None
+            else None
+        )
+        report = {
+            "fallback_file": os.path.basename(path_name),
+            "failures": failures,
+            "epoch": got_epoch,
+            "epochs_lost": epochs_lost,
+        }
+        FaultCounters.inc("ckpt_fallback_loads")
+        if _is_rank_zero():
+            try:
+                record_checkpoint_fallback(
+                    run_dir,
+                    {
+                        "ts_utc": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                        "loaded_file": report["fallback_file"],
+                        "rejected": failures,
+                        "epoch": got_epoch,
+                        "epochs_lost": epochs_lost,
+                    },
+                )
+            except OSError:
+                # A read-only run dir (serving from an artifact mount) must
+                # not turn a SUCCESSFUL recovery into a failure; the counters
+                # and the log line below still carry the event.
+                pass
+            from ..utils.print_utils import log
+
+            log(
+                f"checkpoint fallback: {len(failures)} corrupt/missing "
+                f"candidate(s) skipped, restored {report['fallback_file']} "
+                f"(epoch {got_epoch}, {epochs_lost} epoch(s) lost)"
+            )
+        return new_vars, new_opt, meta, report
+    raise CheckpointChainExhaustedError(run_dir, failures)
+
+
+def load_existing_model(
+    variables: Dict[str, Any],
+    model_name: str,
+    path: str = "./logs/",
+    opt_state: Any = None,
+    return_meta: bool = False,
+    fallback: bool = True,
+):
+    """Restore params/batch_stats (+optimizer state if given a template) from
+    the run's checkpoint, through the verified fallback chain by default
+    (``fallback=False`` loads exactly ``<name>.pk`` or raises). Returns
+    (variables, opt_state), plus the progress meta dict when ``return_meta``
+    (one file read, not two)."""
+    run_dir = os.path.join(path, model_name)
+    if fallback:
+        new_vars, opt_state, meta, _report = load_verified_chain(
+            variables, run_dir, model_name, opt_state
+        )
+    else:
+        new_vars, opt_state, meta = load_checkpoint_file(
+            variables, os.path.join(run_dir, model_name + ".pk"), opt_state
+        )
+    if return_meta:
+        return new_vars, opt_state, meta
+    return new_vars, opt_state
+
+
+def load_existing_model_config(
+    variables, config: Dict[str, Any], path: str = "./logs/", opt_state: Any = None
+):
+    """Warm start when Training.continue is set (reference model.py:57-60)."""
+    if config.get("continue", 0):
+        model_name = config.get("startfrom", "existing_model")
+        return load_existing_model(variables, model_name, path, opt_state)
+    return variables, opt_state
+
+
+def checkpoint_exists(model_name: str, path: str = "./logs/") -> bool:
+    return os.path.exists(os.path.join(path, model_name, model_name + ".pk"))
+
+
+def load_checkpoint_meta(model_name: str, path: str = "./logs/") -> Dict[str, Any]:
+    """Training-progress metadata stored alongside the weights ({} for
+    checkpoints written before meta existed, or when none was saved)."""
+    path_name = os.path.join(path, model_name, model_name + ".pk")
+    _version, payload = read_checkpoint_payload(path_name)
+    return payload.get("meta") or {}
+
+
+# ------------------------------------------------------- migration utilities
+
+
+def update_checkpoint_meta(path_name: str, meta: Dict[str, Any]) -> None:
+    """Rewrite one checkpoint's meta section in place (atomic), re-encoding
+    as v2 whatever the source format was. Test harnesses use this to install
+    mid-run resume states; operators use it for history surgery."""
+    _version, payload = read_checkpoint_payload(path_name)
+    sections = {
+        "params": payload["params"],
+        "batch_stats": payload["batch_stats"],
+        "opt_state": payload.get("opt_state"),
+        "meta": ckpt_format.pack_meta(meta),
+    }
+    header = dict(payload.get("header") or {})
+    header.pop("format_version", None)
+    header["epoch"] = (meta or {}).get("epoch")
+    header["step"] = (meta or {}).get("step")
+    write_checkpoint_blob(path_name, ckpt_format.encode(sections, header))
+
+
+def migrate_checkpoint(path_name: str) -> bool:
+    """v1 pickle → v2 verified container, in place (atomic). Returns True
+    when the file was migrated, False when it already was v2."""
+    version, payload = read_checkpoint_payload(path_name)
+    if version >= ckpt_format.FORMAT_VERSION:
+        return False
+    sections = {
+        "params": payload["params"],
+        "batch_stats": payload["batch_stats"],
+        "opt_state": payload.get("opt_state"),
+        "meta": ckpt_format.pack_meta(payload.get("meta") or {}),
+    }
+    header = {
+        "epoch": (payload.get("meta") or {}).get("epoch"),
+        "step": (payload.get("meta") or {}).get("step"),
+        "migrated_from": 1,
+    }
+    write_checkpoint_blob(path_name, ckpt_format.encode(sections, header))
+    return True
+
+
+def migrate_run_dir(run_dir: str) -> Dict[str, List[str]]:
+    """Migrate every ``*.pk`` checkpoint in a run directory. Returns
+    {migrated: [...], already_v2: [...], failed: [...]}. Corrupt files are
+    left untouched (the fallback chain, not migration, handles those)."""
+    out: Dict[str, List[str]] = {"migrated": [], "already_v2": [], "failed": []}
+    for p in sorted(glob.glob(os.path.join(run_dir, "*.pk"))):
+        try:
+            out["migrated" if migrate_checkpoint(p) else "already_v2"].append(p)
+        except CheckpointError:
+            out["failed"].append(p)
+    return out

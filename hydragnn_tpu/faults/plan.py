@@ -21,12 +21,19 @@ Spec grammar (comma-separated entries, driven by ``HYDRAGNN_FAULTS`` or the
     slow_collate@2:ms=40       # ... only before fed batch 2
     transfer_crash@3           # transfer 3 raises a TRANSIENT error, once
     kill@9                     # SIGKILL this process at fed batch 9
+    corrupt_ckpt@2             # bit-flip a byte in the file of save 2
+    truncate_ckpt@2            # truncate the file of save 2 to half
+    kill@save1                 # SIGKILL right after save 1 completes
 
 Batch/transfer indices are cumulative over the plan's lifetime (one plan per
 TrainingDriver), counted on the pipeline's host/transfer threads in feed
-order — deterministic for a seeded loader. ``kill`` fires only in the first
-incarnation of a supervised run (``HYDRAGNN_RESTART_COUNT`` unset or 0), so a
-restart drill terminates instead of kill-looping forever.
+order — deterministic for a seeded loader. Checkpoint-save indices count
+completed ``save_model`` calls (periodic + final, sync or async) via the
+checkpoint subsystem's post-save hook, which the TrainingDriver registers.
+``kill``/``kill@save`` and the checkpoint-corruption kinds fire only in the
+first incarnation of a supervised run (``HYDRAGNN_RESTART_COUNT`` unset or
+0), so a restart drill terminates — and recovers through the fallback chain —
+instead of corrupting/kill-looping forever.
 """
 
 from __future__ import annotations
@@ -69,7 +76,15 @@ def _parse_steps(sel: str) -> Set[int]:
 class FaultPlan:
     """Parsed fault schedule with the hooks instrumented code consults."""
 
-    KINDS = ("nan_grad", "corrupt_sample", "slow_collate", "transfer_crash", "kill")
+    KINDS = (
+        "nan_grad",
+        "corrupt_sample",
+        "slow_collate",
+        "transfer_crash",
+        "kill",
+        "corrupt_ckpt",
+        "truncate_ckpt",
+    )
 
     def __init__(self, spec: str = ""):
         self.spec = spec or ""
@@ -77,12 +92,16 @@ class FaultPlan:
         self.restart = int(os.environ.get(RESTART_ENV_VAR, "0") or 0)
         self._nan_steps: Set[int] = set()
         self._kill_steps: Set[int] = set()
+        self._kill_saves: Set[int] = set()
         self._slow: list = []  # (steps | None meaning every batch, seconds)
         self._transfer_crashes: Set[int] = set()
+        self._ckpt_corrupt: Set[int] = set()
+        self._ckpt_truncate: Set[int] = set()
         self.corrupt_count = 0
         self.corrupt_frac = 0.0
         self._batch_i = 0
         self._transfer_i = 0
+        self._ckpt_save_i = 0
         self._lock = threading.Lock()
         for raw in filter(None, (p.strip() for p in self.spec.split(","))):
             self._parse_entry(raw)
@@ -105,7 +124,16 @@ class FaultPlan:
         if kind == "nan_grad":
             self._nan_steps |= _parse_steps(sel)
         elif kind == "kill":
-            self._kill_steps |= _parse_steps(sel)
+            # kill@save / kill@saveK: indexed by completed checkpoint save,
+            # not by fed batch — the drill for crash-during-checkpointing.
+            if sel.startswith("save"):
+                self._kill_saves |= _parse_steps(sel[len("save"):] or "0")
+            else:
+                self._kill_steps |= _parse_steps(sel)
+        elif kind == "corrupt_ckpt":
+            self._ckpt_corrupt |= _parse_steps(sel or "0")
+        elif kind == "truncate_ckpt":
+            self._ckpt_truncate |= _parse_steps(sel or "0")
         elif kind == "transfer_crash":
             self._transfer_crashes |= _parse_steps(sel)
         elif kind == "slow_collate":
@@ -128,8 +156,11 @@ class FaultPlan:
         return bool(
             self._nan_steps
             or self._kill_steps
+            or self._kill_saves
             or self._slow
             or self._transfer_crashes
+            or self._ckpt_corrupt
+            or self._ckpt_truncate
             or self.corrupt_count
             or self.corrupt_frac
         )
@@ -172,6 +203,43 @@ class FaultPlan:
             raise InjectedTransientError(
                 f"injected transient transfer failure at transfer {i}"
             )
+
+    # ------------------------------------------------------- checkpoint hook
+    def on_checkpoint_saved(self, path_name: str) -> None:
+        """Consulted by the checkpoint subsystem (``set_post_save_hook``)
+        after every COMPLETED save — sync path or async writer thread. At
+        scheduled save indices, corrupts the just-written file (seeded
+        bit-flip / truncation) or SIGKILLs the process: the drills for the
+        verified loader's fallback chain and the supervisor's resume-through-
+        corruption path. All three are gated to incarnation 0 so a supervised
+        restart recovers instead of re-corrupting its own saves."""
+        with self._lock:
+            i = self._ckpt_save_i
+            self._ckpt_save_i += 1
+        if self.restart != 0:
+            return
+        if i in self._ckpt_corrupt:
+            self._flip_byte(path_name, self.seed + i)
+            FaultCounters.inc("injected_corrupt_ckpt")
+        if i in self._ckpt_truncate:
+            os.truncate(path_name, os.path.getsize(path_name) // 2)
+            FaultCounters.inc("injected_truncate_ckpt")
+        if i in self._kill_saves:
+            FaultCounters.inc("injected_kill")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    @staticmethod
+    def _flip_byte(path_name: str, seed: int) -> None:
+        """XOR one seeded byte in the file body (past any magic prefix, so the
+        drill exercises digest verification, not just format sniffing)."""
+        size = os.path.getsize(path_name)
+        rng = np.random.default_rng(seed)
+        off = int(rng.integers(16, size)) if size > 17 else size - 1
+        with open(path_name, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
 
     # ---------------------------------------------------------- sample hooks
     def corrupt_sample_indices(self, n: int) -> Set[int]:

@@ -38,10 +38,27 @@ SUPERVISOR_META = "supervisor.json"
 
 
 def _atomic_write_json(path: str, doc: dict) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=2)
-    os.replace(tmp, path)
+    # Shared fsync'd unique-tmp install — one durability contract for every
+    # checkpoint-adjacent sidecar (checkpoint/io.atomic_write_json).
+    from ..checkpoint.io import atomic_write_json
+
+    atomic_write_json(path, doc)
+
+
+def _write_meta(meta_path: str, meta: dict) -> dict:
+    """Atomic supervisor.json update that PRESERVES fields other writers own:
+    the verified checkpoint loader records ``checkpoint_fallbacks`` into the
+    same file from inside the CHILD process (docs/CHECKPOINTING.md), and a
+    supervisor rewrite must not clobber them."""
+    try:
+        with open(meta_path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = {}
+    if existing.get("checkpoint_fallbacks"):
+        meta = dict(meta, checkpoint_fallbacks=existing["checkpoint_fallbacks"])
+    _atomic_write_json(meta_path, meta)
+    return meta
 
 
 def _prepare_config(config: dict) -> dict:
@@ -137,10 +154,9 @@ def run_supervised(
         )
         if proc.returncode == 0:
             meta["completed"] = True
-            _atomic_write_json(meta_path, meta)
-            return meta
+            return _write_meta(meta_path, meta)
         if attempt >= max_restarts:
-            _atomic_write_json(meta_path, meta)
+            _write_meta(meta_path, meta)
             raise RuntimeError(
                 f"supervised training failed after {attempt} restart(s) "
                 f"(max_restarts={max_restarts}); attempt log: {meta_path}"
@@ -148,7 +164,7 @@ def run_supervised(
         attempt += 1
         meta["restarts"] = attempt
         FaultCounters.inc("restarts")
-        _atomic_write_json(meta_path, meta)
+        meta = _write_meta(meta_path, meta)
 
 
 def main(argv=None) -> int:

@@ -18,6 +18,7 @@ from .train.trainer import create_train_state
 from .utils.config_utils import get_log_name_config, update_config
 from .utils.model import load_existing_model
 from .utils.optimizer import select_optimizer
+from .utils.print_utils import print_distributed
 
 
 @singledispatch
@@ -68,7 +69,17 @@ def _(config: dict, mesh=None):
         model = model.clone(graph_axis="graph")
 
     log_name = get_log_name_config(config)
-    variables, _ = load_existing_model(variables, log_name)
+    # Verified load (docs/CHECKPOINTING.md): digest-checked v2 read with the
+    # corruption fallback chain — a bit-flipped latest checkpoint serves
+    # predictions from the newest intact retained entry instead of dying.
+    variables, _, ckpt_meta = load_existing_model(
+        variables, log_name, return_meta=True
+    )
+    print_distributed(
+        config["Verbosity"]["level"],
+        f"Restored checkpoint for {log_name} "
+        f"(epoch {ckpt_meta.get('epoch', '?')})",
+    )
 
     optimizer = select_optimizer("AdamW", 1e-3)  # unused for inference
     state = create_train_state(model, variables, optimizer)

@@ -169,6 +169,15 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
                     "multi-host resume requires ./logs on shared storage"
                 )
         if have:
+            # Rank-0 save/restore points must not overlap across ranks: a
+            # non-zero rank racing ahead here could read <name>.pk while a
+            # rank-0 writer (a previous incarnation's final save, or a late
+            # async flush) is still installing it.
+            barrier("checkpoint_resume")
+            # Verified load with the corruption fallback chain: a torn or
+            # bit-flipped latest checkpoint falls back to the newest intact
+            # keep_last_k entry instead of killing the (supervised) restart
+            # loop (docs/CHECKPOINTING.md).
             new_vars, opt_state, meta = load_existing_model(
                 {"params": state.params, "batch_stats": state.batch_stats},
                 log_name,
@@ -259,6 +268,9 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
         ),
         checkpoint_keep_last_k=config["NeuralNetwork"]["Training"].get(
             "checkpoint_keep_last_k", 0
+        ),
+        checkpoint_async=bool(
+            config["NeuralNetwork"]["Training"].get("checkpoint_async", 1)
         ),
         start_epoch=start_epoch,
         history=prior_history,

@@ -873,8 +873,22 @@ class InferenceEngine:
 
     @staticmethod
     def _sniff_format(path: str) -> str:
-        """Native checkpoints are a plain pickle of {"params": bytes, ...};
-        torch.save writes a zip archive plain pickle cannot read."""
+        """Native v2 checkpoints carry the HGNN2 magic (sniffed WITHOUT
+        executing any deserializer); torch.save writes a zip archive (PK
+        magic). Legacy native v1 files are a plain pickle of
+        {"params": bytes, ...} — the one remaining pickle sniff, kept through
+        the v1 read-compat window (docs/CHECKPOINTING.md "Migration")."""
+        from ..checkpoint import MAGIC
+
+        try:
+            with open(path, "rb") as f:
+                head = f.read(max(len(MAGIC), 2))
+        except OSError:
+            return "torch"
+        if head[: len(MAGIC)] == MAGIC:
+            return "native"
+        if head[:2] == b"PK":  # zip archive: torch.save
+            return "torch"
         try:
             with open(path, "rb") as f:
                 payload = pickle.load(f)

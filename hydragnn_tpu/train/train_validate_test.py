@@ -92,6 +92,17 @@ class TrainingDriver:
         self.fault_plan = (
             fault_plan if fault_plan is not None else FaultPlan.from_env()
         )
+        # Checkpoint drills (corrupt_ckpt/truncate_ckpt/kill@save) ride the
+        # checkpoint subsystem's post-save hook. Registered (or CLEARED — a
+        # stale hook from a previous driver must never corrupt this run's
+        # saves) for every driver construction.
+        from ..checkpoint import set_post_save_hook
+
+        set_post_save_hook(
+            self.fault_plan.on_checkpoint_saved
+            if self.fault_plan is not None and self.fault_plan.active
+            else None
+        )
         guard = self.guard is not None
         if mesh is not None:
             # Each process stacks only its LOCAL slice of the data axis; the
@@ -616,6 +627,7 @@ def train_validate_test(
     checkpoint_name: Optional[str] = None,
     checkpoint_every: int = 0,
     checkpoint_keep_last_k: int = 0,
+    checkpoint_async: bool = True,
     start_epoch: int = 0,
     history: Optional[dict] = None,
 ):
@@ -641,83 +653,120 @@ def train_validate_test(
     }
     timer = Timer("train_validate_test")
     timer.start()
-    for epoch in range(start_epoch, num_epoch):
-        for loader in (train_loader, val_loader, test_loader):
-            if hasattr(loader, "set_epoch"):
-                loader.set_epoch(epoch)
-        if profiler:
-            profiler.set_current_epoch(epoch)
+    # Async checkpointing (docs/CHECKPOINTING.md): periodic saves snapshot
+    # device→host on this thread and hand serialize/fsync/rename to a single
+    # background writer — the epoch loop stalls for the snapshot only. The
+    # per-save stall (async) or full save wall (sync) is credited to the
+    # ``ckpt_save_stall`` timer so print_timers/bench expose what
+    # checkpointing costs the training thread.
+    checkpointer = None
+    if checkpoint_name and checkpoint_every > 0 and checkpoint_async:
+        from ..checkpoint import AsyncCheckpointer
 
-        train_loss, train_rmses = driver.train_epoch(train_loader, profiler)
-        val_loss, val_rmses = driver.evaluate(val_loader, profiler=profiler)
-        test_loss, test_rmses = driver.evaluate(test_loader, profiler=profiler)
+        checkpointer = AsyncCheckpointer()
+    try:
+        for epoch in range(start_epoch, num_epoch):
+            for loader in (train_loader, val_loader, test_loader):
+                if hasattr(loader, "set_epoch"):
+                    loader.set_epoch(epoch)
+            if profiler:
+                profiler.set_current_epoch(epoch)
 
-        if scheduler is not None:
-            current_lr = get_learning_rate(driver.state.opt_state)
-            # None = no injected LR knob (LBFGS: linesearch owns the step
-            # size) — the plateau scheduler has nothing to act on.
-            new_lr = (
-                scheduler.step(val_loss, current_lr)
-                if current_lr is not None
-                else None
-            )
-            if new_lr is not None and new_lr != current_lr:
-                driver.state = driver.state.replace(
-                    opt_state=set_learning_rate(driver.state.opt_state, new_lr)
+            train_loss, train_rmses = driver.train_epoch(train_loader, profiler)
+            val_loss, val_rmses = driver.evaluate(val_loader, profiler=profiler)
+            test_loss, test_rmses = driver.evaluate(test_loader, profiler=profiler)
+
+            if scheduler is not None:
+                current_lr = get_learning_rate(driver.state.opt_state)
+                # None = no injected LR knob (LBFGS: linesearch owns the step
+                # size) — the plateau scheduler has nothing to act on.
+                new_lr = (
+                    scheduler.step(val_loss, current_lr)
+                    if current_lr is not None
+                    else None
                 )
-                print_distributed(
-                    verbosity, f"Epoch {epoch}: learning rate reduced to {new_lr}"
+                if new_lr is not None and new_lr != current_lr:
+                    driver.state = driver.state.replace(
+                        opt_state=set_learning_rate(driver.state.opt_state, new_lr)
+                    )
+                    print_distributed(
+                        verbosity,
+                        f"Epoch {epoch}: learning rate reduced to {new_lr}",
+                    )
+
+            if writer is not None:
+                writer.add_scalar("train error", train_loss, epoch)
+                writer.add_scalar("validate error", val_loss, epoch)
+                writer.add_scalar("test error", test_loss, epoch)
+                for ivar, rmse in enumerate(train_rmses):
+                    writer.add_scalar(f"train error of task {ivar}", rmse, epoch)
+
+            print_distributed(
+                verbosity,
+                f"Epoch: {epoch:4d}  Train: {train_loss:.8f}  "
+                f"Val: {val_loss:.8f}  Test: {test_loss:.8f}",
+            )
+            history["total_loss_train"].append(train_loss)
+            history["total_loss_val"].append(val_loss)
+            history["total_loss_test"].append(test_loss)
+            history["task_loss_train"].append(train_rmses)
+            history["task_loss_val"].append(val_rmses)
+            history["task_loss_test"].append(test_rmses)
+
+            if visualizer is not None and plot_hist_solution:
+                _, _, tv, pv = driver.evaluate(test_loader, return_values=True)
+                visualizer.create_scatter_plots(
+                    tv, pv, output_names=output_names, iepoch=epoch
                 )
 
-        if writer is not None:
-            writer.add_scalar("train error", train_loss, epoch)
-            writer.add_scalar("validate error", val_loss, epoch)
-            writer.add_scalar("test error", test_loss, epoch)
-            for ivar, rmse in enumerate(train_rmses):
-                writer.add_scalar(f"train error of task {ivar}", rmse, epoch)
-
-        print_distributed(
-            verbosity,
-            f"Epoch: {epoch:4d}  Train: {train_loss:.8f}  Val: {val_loss:.8f}  "
-            f"Test: {test_loss:.8f}",
-        )
-        history["total_loss_train"].append(train_loss)
-        history["total_loss_val"].append(val_loss)
-        history["total_loss_test"].append(test_loss)
-        history["task_loss_train"].append(train_rmses)
-        history["task_loss_val"].append(val_rmses)
-        history["task_loss_test"].append(test_rmses)
-
-        if visualizer is not None and plot_hist_solution:
-            _, _, tv, pv = driver.evaluate(test_loader, return_values=True)
-            visualizer.create_scatter_plots(
-                tv, pv, output_names=output_names, iepoch=epoch
-            )
-
-        # Mid-training periodic checkpoint — an improvement over the
-        # reference, which saves only once at the very end (SURVEY.md §5.4);
-        # a preempted multi-hour run can warm-start from the last save.
-        if (
-            checkpoint_name
-            and checkpoint_every > 0
-            and (epoch + 1) % checkpoint_every == 0
-        ):
-            from ..utils.model import save_model
-
-            save_model(
-                {
+            # Mid-training periodic checkpoint — an improvement over the
+            # reference, which saves only once at the very end (SURVEY.md
+            # §5.4); a preempted multi-hour run warm-starts from the last
+            # save. Non-blocking by default (checkpoint_async).
+            if (
+                checkpoint_name
+                and checkpoint_every > 0
+                and (epoch + 1) % checkpoint_every == 0
+            ):
+                ckpt_vars = {
                     "params": driver.state.params,
                     "batch_stats": driver.state.batch_stats,
-                },
-                driver.state.opt_state,
-                checkpoint_name,
-                meta={
+                }
+                ckpt_meta = {
                     "epoch": epoch + 1,
                     "scheduler": scheduler.state_dict() if scheduler else None,
                     "history": history,
-                },
-                keep_last_k=checkpoint_keep_last_k,
-            )
+                }
+                if checkpointer is not None:
+                    stall = checkpointer.save(
+                        ckpt_vars,
+                        driver.state.opt_state,
+                        checkpoint_name,
+                        meta=ckpt_meta,
+                        keep_last_k=checkpoint_keep_last_k,
+                    )
+                else:
+                    from ..utils.model import save_model
+
+                    t0 = time.perf_counter()
+                    save_model(
+                        ckpt_vars,
+                        driver.state.opt_state,
+                        checkpoint_name,
+                        meta=ckpt_meta,
+                        keep_last_k=checkpoint_keep_last_k,
+                    )
+                    stall = time.perf_counter() - t0
+                Timer.credit("ckpt_save_stall", stall)
+    finally:
+        if checkpointer is not None:
+            # Run-exit wait barrier: every queued write lands before the run
+            # returns (resume/predict reads the file next). On the clean path
+            # a writer failure re-raises here; on an exception path it must
+            # not mask the original error.
+            import sys as _sys
+
+            checkpointer.close(raise_errors=_sys.exc_info()[0] is None)
     if profiler:
         profiler.stop()
     timer.stop()

@@ -1,229 +1,39 @@
-"""Checkpointing, TensorBoard, and model statistics
+"""Checkpointing wrappers, TensorBoard, and model statistics
 (reference /root/reference/hydragnn/utils/model.py:28-97).
 
-Checkpoint format: single file ``./logs/<name>/<name>.pk`` holding msgpack-encoded
-{params, batch_stats, opt_state} via flax.serialization — same single-file,
-rank-0-only semantics as the reference's torch.save of
-{model_state_dict, optimizer_state_dict}. Improvement over reference (documented
-divergence, SURVEY.md §5.4): ``save_model`` can be called periodically, and
-``get_summary_writer`` actually RETURNS the writer (the reference's returns None,
-leaving its TensorBoard path dead — model.py:50-54)."""
+The checkpoint implementation moved to :mod:`hydragnn_tpu.checkpoint`
+(verified v2 msgpack format, corruption fallback chain, async writer —
+docs/CHECKPOINTING.md); this module keeps the historical public names as
+thin re-exports so every existing consumer (run_training, run_prediction,
+serve engine, tests) is source-compatible. Same single-file, rank-0-only
+semantics as the reference's torch.save of {model_state_dict,
+optimizer_state_dict}; improvement over reference (documented divergence,
+SURVEY.md §5.4): ``save_model`` can be called periodically, and
+``get_summary_writer`` actually RETURNS the writer (the reference's returns
+None, leaving its TensorBoard path dead — model.py:50-54)."""
 
 from __future__ import annotations
 
-import glob
-import json
 import os
-import pickle
-import time
-from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
-from flax import serialization
 
+from ..checkpoint import (  # noqa: F401  (public re-exports)
+    checkpoint_exists,
+    cleanup_stale_checkpoint_tmp,
+    load_checkpoint_file,
+    load_checkpoint_manifest,
+    load_checkpoint_meta,
+    load_existing_model,
+    load_existing_model_config,
+    save_model,
+)
 from .print_utils import print_distributed
 
 
 def _is_rank_zero() -> bool:
     return jax.process_index() == 0
-
-
-def cleanup_stale_checkpoint_tmp(run_dir: str) -> List[str]:
-    """Remove ``*.tmp`` files a crash left behind mid-``os.replace``. Safe to
-    call whenever no save is in flight — checkpoint writes are rank-0 and
-    single-threaded, so any ``.tmp`` present at save entry (or at run/resume
-    startup) is by construction a torn leftover, never a live write. Returns
-    the removed paths (logged by the fault drills)."""
-    removed = []
-    for p in glob.glob(os.path.join(run_dir, "*.tmp")):
-        try:
-            os.remove(p)
-            removed.append(p)
-        except OSError:
-            pass
-    return removed
-
-
-def _manifest_path(run_dir: str, name: str) -> str:
-    return os.path.join(run_dir, name + ".manifest.json")
-
-
-def load_checkpoint_manifest(
-    name: str, path: str = "./logs/"
-) -> Dict[str, Any]:
-    """The retention manifest written by ``save_model(keep_last_k=...)``
-    ({} when retention was never enabled, or the manifest is torn)."""
-    try:
-        with open(_manifest_path(os.path.join(path, name), name)) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
-
-
-def _retain_checkpoints(
-    run_dir: str, name: str, latest: str, keep_last_k: int, meta
-) -> None:
-    """keep_last_k retention: hard-link the just-written latest checkpoint to
-    an epoch-tagged retained file, prune retained files beyond k, and update
-    the manifest ATOMICALLY (tmp + os.replace) — a crash at any point leaves
-    either the old or the new manifest, both listing only files that exist."""
-    epoch = (meta or {}).get("epoch")
-    try:
-        with open(_manifest_path(run_dir, name)) as f:
-            manifest = json.load(f)
-    except (OSError, ValueError):
-        manifest = {}
-    entries = [
-        e
-        for e in manifest.get("entries", [])
-        if os.path.exists(os.path.join(run_dir, e["file"]))
-    ]
-    serial = (max((e.get("serial", 0) for e in entries), default=0)) + 1
-    tag = f"e{int(epoch):06d}" if epoch is not None else f"s{serial:06d}"
-    retained = f"{name}.{tag}.pk"
-    retained_path = os.path.join(run_dir, retained)
-    link_tmp = retained_path + ".tmp"
-    if os.path.exists(link_tmp):
-        os.remove(link_tmp)
-    try:
-        os.link(latest, link_tmp)  # same content, no second serialization
-        os.replace(link_tmp, retained_path)
-    except OSError:
-        import shutil  # filesystems without hard links
-
-        shutil.copyfile(latest, link_tmp)
-        os.replace(link_tmp, retained_path)
-    entries = [e for e in entries if e["file"] != retained]
-    entries.append(
-        {
-            "file": retained,
-            "epoch": epoch,
-            "serial": serial,
-            "saved_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        }
-    )
-    entries.sort(key=lambda e: e["serial"])
-    for drop in entries[:-keep_last_k] if keep_last_k > 0 else []:
-        try:
-            os.remove(os.path.join(run_dir, drop["file"]))
-        except OSError:
-            pass
-    entries = entries[-keep_last_k:] if keep_last_k > 0 else entries
-    doc = {"name": name, "keep_last_k": keep_last_k, "entries": entries}
-    mpath = _manifest_path(run_dir, name)
-    mtmp = mpath + ".tmp"
-    with open(mtmp, "w") as f:
-        json.dump(doc, f, indent=2)
-    os.replace(mtmp, mpath)
-
-
-def save_model(
-    variables: Dict[str, Any],
-    opt_state: Any,
-    name: str,
-    path: str = "./logs/",
-    meta: Optional[Dict[str, Any]] = None,
-    keep_last_k: int = 0,
-) -> None:
-    """Rank-0 single-file checkpoint (model.py:35-47). ``meta`` carries
-    training progress (epoch, scheduler state, loss history) so a preempted
-    run can resume exactly where it stopped (Training.resume).
-
-    ``keep_last_k > 0`` additionally retains the last k checkpoints as
-    epoch-tagged hard links next to the latest (``<name>.e000004.pk``) with an
-    atomically-updated ``<name>.manifest.json`` — a corrupted-latest scenario
-    (or a rollback past the last save) has history to fall back on. The
-    ``<name>.pk`` latest-checkpoint contract is unchanged either way."""
-    if not _is_rank_zero():
-        return
-    path_name = os.path.join(path, name, name + ".pk")
-    payload = {
-        "params": serialization.to_bytes(variables["params"]),
-        "batch_stats": serialization.to_bytes(variables.get("batch_stats", {})),
-        "opt_state": serialization.to_bytes(opt_state)
-        if opt_state is not None
-        else None,
-    }
-    if meta is not None:
-        payload["meta"] = meta
-    run_dir = os.path.dirname(path_name)
-    os.makedirs(run_dir, exist_ok=True)
-    # A crash mid-os.replace in an EARLIER incarnation leaves *.tmp litter;
-    # a save in flight is impossible here (rank-0, single-threaded).
-    cleanup_stale_checkpoint_tmp(run_dir)
-    # Atomic write: a crash mid-dump must not leave a torn checkpoint that a
-    # later warm start would fail on.
-    tmp_name = path_name + ".tmp"
-    with open(tmp_name, "wb") as f:
-        pickle.dump(payload, f)
-    os.replace(tmp_name, path_name)
-    if keep_last_k and keep_last_k > 0:
-        _retain_checkpoints(run_dir, name, path_name, int(keep_last_k), meta)
-
-
-def load_checkpoint_file(
-    variables: Dict[str, Any], path_name: str, opt_state: Any = None
-):
-    """Restore one checkpoint FILE (the save_model payload) onto a variables
-    template. The single deserialization implementation — the log-name
-    convenience below and direct-path consumers (serve engine) share it, so
-    a payload-schema change cannot diverge them. Returns
-    (variables, opt_state, meta)."""
-    with open(path_name, "rb") as f:
-        payload = pickle.load(f)
-    new_vars = dict(variables)
-    new_vars["params"] = serialization.from_bytes(
-        variables["params"], payload["params"]
-    )
-    new_vars["batch_stats"] = serialization.from_bytes(
-        variables.get("batch_stats", {}), payload["batch_stats"]
-    )
-    if opt_state is not None and payload.get("opt_state") is not None:
-        opt_state = serialization.from_bytes(opt_state, payload["opt_state"])
-    return new_vars, opt_state, payload.get("meta") or {}
-
-
-def load_existing_model(
-    variables: Dict[str, Any],
-    model_name: str,
-    path: str = "./logs/",
-    opt_state: Any = None,
-    return_meta: bool = False,
-):
-    """Restore params/batch_stats (+optimizer state if given a template) from the
-    single-file checkpoint (model.py:63-78). Returns (variables, opt_state), plus
-    the progress meta dict when ``return_meta`` (one file read, not two)."""
-    path_name = os.path.join(path, model_name, model_name + ".pk")
-    new_vars, opt_state, meta = load_checkpoint_file(
-        variables, path_name, opt_state
-    )
-    if return_meta:
-        return new_vars, opt_state, meta
-    return new_vars, opt_state
-
-
-def load_existing_model_config(
-    variables, config: Dict[str, Any], path: str = "./logs/", opt_state: Any = None
-):
-    """Warm start when Training.continue is set (model.py:57-60)."""
-    if config.get("continue", 0):
-        model_name = config.get("startfrom", "existing_model")
-        return load_existing_model(variables, model_name, path, opt_state)
-    return variables, opt_state
-
-
-def checkpoint_exists(model_name: str, path: str = "./logs/") -> bool:
-    return os.path.exists(os.path.join(path, model_name, model_name + ".pk"))
-
-
-def load_checkpoint_meta(model_name: str, path: str = "./logs/") -> Dict[str, Any]:
-    """Training-progress metadata stored alongside the weights ({} for
-    checkpoints written before meta existed, or when none was saved)."""
-    path_name = os.path.join(path, model_name, model_name + ".pk")
-    with open(path_name, "rb") as f:
-        payload = pickle.load(f)
-    return payload.get("meta") or {}
 
 
 def get_summary_writer(name: str, path: str = "./logs/"):

@@ -104,9 +104,12 @@ def pytest_periodic_checkpoint(tmp_path, monkeypatch):
 
 def pytest_keep_last_k_retention_manifest_and_tmp_cleanup(tmp_path):
     """save_model(keep_last_k=2): epoch-tagged retained checkpoints pruned to
-    the last 2 with an atomically-updated manifest, stale *.tmp litter from a
-    crashed earlier save removed at save entry, and the latest-checkpoint
-    contract (<name>.pk) intact."""
+    the last 2 with an atomically-updated manifest, the latest-checkpoint
+    contract (<name>.pk) intact, and tmp hygiene per the async-writer rules:
+    saves use writer-owned UNIQUE tmp names and leave none behind, a foreign
+    ``.tmp`` is NOT touched at save entry (it could be a live concurrent
+    async write — cleanup is scoped to run startup), and the explicit startup
+    cleanup helper removes it."""
     from hydragnn_tpu.utils.model import (
         cleanup_stale_checkpoint_tmp,
         load_checkpoint_manifest,
@@ -120,16 +123,18 @@ def pytest_keep_last_k_retention_manifest_and_tmp_cleanup(tmp_path):
 
     run_dir = tmp_path / "ret_unit"
     os.makedirs(run_dir)
-    # Torn leftovers of a crash mid-os.replace: must vanish on the next save.
-    (run_dir / "ret_unit.pk.tmp").write_bytes(b"torn")
+    # A foreign tmp (torn leftover OR a concurrent writer's live file): save
+    # must neither fail on it nor delete it.
+    (run_dir / "ret_unit.pk.tmp").write_bytes(b"foreign")
     for epoch in (1, 2, 3):
         save_model(
             variables, opt_state, "ret_unit", path=str(tmp_path) + "/",
             meta={"epoch": epoch}, keep_last_k=2,
         )
     files = sorted(os.listdir(run_dir))
-    assert "ret_unit.pk.tmp" not in files, "stale tmp survived a save"
-    assert not glob.glob(str(run_dir / "*.tmp"))
+    assert "ret_unit.pk.tmp" in files, "save entry must not remove foreign tmp"
+    # ... but the saves' own unique tmp names all got renamed away.
+    assert glob.glob(str(run_dir / "*.tmp")) == [str(run_dir / "ret_unit.pk.tmp")]
     # Latest + last-2 retained; epoch 1 pruned.
     assert "ret_unit.pk" in files
     assert "ret_unit.e000002.pk" in files and "ret_unit.e000003.pk" in files
@@ -147,10 +152,11 @@ def pytest_keep_last_k_retention_manifest_and_tmp_cleanup(tmp_path):
         str(run_dir / "ret_unit.e000002.pk"),
     )
     assert meta["epoch"] == 2
-    # Explicit startup cleanup helper (run_training resume path).
+    # Explicit startup cleanup helper (run_training/supervisor startup, when
+    # no writer can be in flight) removes the foreign tmp and any junk.
     (run_dir / "junk.tmp").write_bytes(b"x")
     removed = cleanup_stale_checkpoint_tmp(str(run_dir))
-    assert removed and not glob.glob(str(run_dir / "*.tmp"))
+    assert len(removed) == 2 and not glob.glob(str(run_dir / "*.tmp"))
 
 
 def pytest_supervisor_restarts_killed_scan_run(tmp_path, monkeypatch):

@@ -1,0 +1,406 @@
+"""Verified checkpoint subsystem (docs/CHECKPOINTING.md): v2 integrity-checked
+format + v1 read-compat/migration, the corruption fallback chain, and the
+async writer's byte-identity / wait-barrier / error-propagation contracts —
+including the end-to-end ``corrupt_ckpt`` resume drill the acceptance
+criteria pin (a seeded corruption of the latest checkpoint resumes training
+from the newest intact retained entry, with the fallback recorded)."""
+
+import glob
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import hydragnn_tpu.checkpoint.io as ckpt_io
+from hydragnn_tpu.checkpoint import (
+    MAGIC,
+    AsyncCheckpointer,
+    CheckpointChainExhaustedError,
+    CheckpointCorruptError,
+    CheckpointError,
+    load_checkpoint_file,
+    load_checkpoint_meta,
+    load_existing_model,
+    migrate_run_dir,
+    save_model,
+    verify_checkpoint_file,
+)
+from hydragnn_tpu.faults import FaultCounters, FaultPlan
+from hydragnn_tpu.utils.optimizer import select_optimizer
+
+
+def _state(scale: float = 1.0):
+    params = {
+        "dense": {
+            "kernel": np.arange(12, dtype=np.float32).reshape(4, 3) * scale,
+            "bias": np.ones(3, np.float32) * scale,
+        }
+    }
+    variables = {"params": params, "batch_stats": {}}
+    opt = select_optimizer("AdamW", 1e-3)
+    return variables, opt.init(params)
+
+
+def _zero_template(variables):
+    import jax
+
+    return {
+        "params": jax.tree_util.tree_map(lambda p: p * 0, variables["params"]),
+        "batch_stats": {},
+    }
+
+
+def _flip_byte(path, off=120):
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def pytest_v2_roundtrip_magic_and_verify(tmp_path):
+    variables, opt_state = _state()
+    meta = {"epoch": 5, "history": {"total_loss_train": [0.5, 0.25]}}
+    save_model(variables, opt_state, "v2", path=str(tmp_path) + "/", meta=meta)
+    ckpt = tmp_path / "v2" / "v2.pk"
+    with open(ckpt, "rb") as f:
+        assert f.read(len(MAGIC)) == MAGIC, "v2 saves must carry the magic"
+    opt = select_optimizer("AdamW", 1e-3)
+    restored, ropt, rmeta = load_existing_model(
+        _zero_template(variables),
+        "v2",
+        path=str(tmp_path) + "/",
+        opt_state=opt.init(variables["params"]),
+        return_meta=True,
+    )
+    np.testing.assert_array_equal(
+        restored["params"]["dense"]["kernel"], variables["params"]["dense"]["kernel"]
+    )
+    assert rmeta == meta  # meta is msgpack round-tripped, not pickled
+    report = verify_checkpoint_file(str(ckpt))
+    assert report["ok"] and report["format_version"] == 2 and report["epoch"] == 5
+
+
+def pytest_v2_digests_catch_bitflip_truncation_garbage(tmp_path):
+    variables, opt_state = _state()
+    save_model(variables, opt_state, "dmg", path=str(tmp_path) + "/")
+    ckpt = str(tmp_path / "dmg" / "dmg.pk")
+    template = _zero_template(variables)
+
+    _flip_byte(ckpt)
+    with pytest.raises(CheckpointCorruptError, match="digest mismatch"):
+        load_checkpoint_file(template, ckpt)
+
+    save_model(variables, opt_state, "dmg", path=str(tmp_path) + "/")
+    os.truncate(ckpt, os.path.getsize(ckpt) // 2)
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint_file(template, ckpt)
+
+    with open(ckpt, "wb") as f:
+        f.write(b"not a checkpoint at all")
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint_file(template, ckpt)
+    assert not verify_checkpoint_file(ckpt)["ok"]
+
+
+def pytest_outer_version_field_cannot_bypass_fallback_chain(tmp_path):
+    """The outer format_version framing field is covered by no digest, so it
+    must be ADVISORY only: a flipped byte there must not make an intact file
+    unreadable (which would bypass the corruption fallback chain with a
+    non-corrupt error). The digest-verified HEADER copy is authoritative —
+    an intact file genuinely claiming a newer version fails loudly."""
+    import hashlib
+
+    import msgpack
+
+    variables, opt_state = _state()
+    save_model(
+        variables, opt_state, "vf", path=str(tmp_path) + "/",
+        meta={"epoch": 1}, keep_last_k=2,
+    )
+    ckpt = str(tmp_path / "vf" / "vf.pk")
+    with open(ckpt, "rb") as f:
+        blob = f.read()
+    # Flip the OUTER format_version value byte (fixstr "format_version" is
+    # 0xae-prefixed; the positive-fixint value follows it) to 127.
+    idx = blob.index(b"\xaeformat_version", len(MAGIC))
+    off = idx + 1 + len("format_version")
+    assert blob[off] == 2
+    with open(ckpt, "wb") as f:
+        f.write(blob[:off] + bytes([0x7F]) + blob[off + 1:])
+    _, _, meta = load_existing_model(
+        _zero_template(variables), "vf", path=str(tmp_path) + "/", return_meta=True
+    )
+    assert meta["epoch"] == 1, "intact file must load despite outer-field flip"
+
+    # Genuine newer version (digest-consistent header) fails loudly, and the
+    # chain does NOT silently walk past it to an older entry.
+    doc = msgpack.unpackb(blob[len(MAGIC):], raw=False, strict_map_key=False)
+    header = msgpack.unpackb(doc["header"], raw=False, strict_map_key=False)
+    header["format_version"] = 99
+    hb = msgpack.packb(header, use_bin_type=True)
+    doc["header"] = hb
+    doc["digests"]["__header__"] = hashlib.sha256(hb).hexdigest()
+    with open(ckpt, "wb") as f:
+        f.write(MAGIC + msgpack.packb(doc, use_bin_type=True))
+    with pytest.raises(CheckpointError, match="format_version"):
+        load_checkpoint_file(_zero_template(variables), ckpt)
+
+
+def pytest_v1_read_compat_warns_once_and_migrates(tmp_path, monkeypatch):
+    """A legacy v1 pickle checkpoint still loads (read-compat window) with a
+    one-time DeprecationWarning naming the migration command; migration
+    rewrites it as v2 in place with meta intact."""
+    import pickle
+
+    from flax import serialization
+
+    variables, opt_state = _state()
+    run_dir = tmp_path / "old"
+    os.makedirs(run_dir)
+    with open(run_dir / "old.pk", "wb") as f:
+        pickle.dump(
+            {
+                "params": serialization.to_bytes(variables["params"]),
+                "batch_stats": serialization.to_bytes({}),
+                "opt_state": None,
+                "meta": {"epoch": 7},
+            },
+            f,
+        )
+    monkeypatch.setattr(ckpt_io, "_v1_warned", False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        restored, _, meta = load_existing_model(
+            _zero_template(variables), "old", path=str(tmp_path) + "/",
+            return_meta=True,
+        )
+        dep = [w for w in caught if issubclass(w.category, DeprecationWarning)]
+    assert meta["epoch"] == 7
+    np.testing.assert_array_equal(
+        restored["params"]["dense"]["bias"], variables["params"]["dense"]["bias"]
+    )
+    assert len(dep) == 1 and "python -m hydragnn_tpu.checkpoint migrate" in str(
+        dep[0].message
+    )
+    with warnings.catch_warnings(record=True) as again:
+        warnings.simplefilter("always")
+        load_existing_model(_zero_template(variables), "old", path=str(tmp_path) + "/")
+    assert not [w for w in again if issubclass(w.category, DeprecationWarning)], (
+        "v1 deprecation warning must fire once per process, not per load"
+    )
+
+    result = migrate_run_dir(str(run_dir))
+    assert [os.path.basename(p) for p in result["migrated"]] == ["old.pk"]
+    with open(run_dir / "old.pk", "rb") as f:
+        assert f.read(len(MAGIC)) == MAGIC
+    assert load_checkpoint_meta("old", path=str(tmp_path) + "/")["epoch"] == 7
+    # Second migrate is a no-op; the CLI agrees.
+    assert migrate_run_dir(str(run_dir))["already_v2"]
+    from hydragnn_tpu.checkpoint.__main__ import main as ckpt_cli
+
+    assert ckpt_cli(["verify", str(run_dir)]) == 0
+
+
+def pytest_fingerprint_mismatch_fails_loudly_not_silently(tmp_path):
+    """Loading a checkpoint saved from a DIFFERENT model raises immediately —
+    an operator error the fallback chain must not walk past (every retained
+    entry would mismatch identically)."""
+    variables, opt_state = _state()
+    save_model(variables, opt_state, "fp", path=str(tmp_path) + "/", keep_last_k=2)
+    other = {
+        "params": {"other": {"w": np.zeros((2, 2), np.float32)}},
+        "batch_stats": {},
+    }
+    with pytest.raises(CheckpointError, match="fingerprint mismatch"):
+        load_existing_model(other, "fp", path=str(tmp_path) + "/")
+
+
+def pytest_fallback_chain_recovers_newest_intact(tmp_path):
+    """The acceptance-criteria mechanism in unit form: corrupt latest (which
+    also corrupts its hard-linked newest retained twin) → the verified load
+    returns the newest INTACT retained entry, counts the corruption, and
+    records the fallback in the run's supervisor.json."""
+    variables, opt_state = _state()
+    for epoch in (1, 2, 3):
+        save_model(
+            variables, opt_state, "fb", path=str(tmp_path) + "/",
+            meta={"epoch": epoch}, keep_last_k=3,
+        )
+    ckpt = str(tmp_path / "fb" / "fb.pk")
+    _flip_byte(ckpt)
+    before_fb = FaultCounters.get("ckpt_fallback_loads")
+    before_cd = FaultCounters.get("ckpt_corrupt_detected")
+    _, _, meta = load_existing_model(
+        _zero_template(variables), "fb", path=str(tmp_path) + "/", return_meta=True
+    )
+    assert meta["epoch"] == 2, "newest intact retained entry is epoch 2"
+    assert FaultCounters.get("ckpt_fallback_loads") == before_fb + 1
+    # latest + the hard-linked e000003 twin both detected corrupt
+    assert FaultCounters.get("ckpt_corrupt_detected") == before_cd + 2
+    with open(tmp_path / "fb" / "supervisor.json") as f:
+        events = json.load(f)["checkpoint_fallbacks"]
+    assert events and events[-1]["loaded_file"] == "fb.e000002.pk"
+    assert events[-1]["epochs_lost"] == 1
+    assert len(events[-1]["rejected"]) == 2
+
+    # Damage the whole chain -> loud exhaustion listing every candidate.
+    for p in glob.glob(str(tmp_path / "fb" / "fb*.pk")):
+        os.truncate(p, 10)
+    with pytest.raises(CheckpointChainExhaustedError, match="exhausted"):
+        load_existing_model(_zero_template(variables), "fb", path=str(tmp_path) + "/")
+
+
+def pytest_async_sync_saves_byte_identical(tmp_path):
+    """One serializer feeds both paths: the same state saved synchronously
+    and through the async writer produces byte-identical files (manifest
+    timestamps aside — the checkpoint itself is wall-clock-free)."""
+    variables, opt_state = _state(scale=2.5)
+    meta = {"epoch": 4, "history": {"total_loss_train": [0.4, 0.3, 0.2, 0.1]}}
+    save_model(variables, opt_state, "sync", path=str(tmp_path) + "/", meta=meta)
+    ac = AsyncCheckpointer()
+    stall = ac.save(variables, opt_state, "async", path=str(tmp_path) + "/", meta=meta)
+    ac.close()
+    assert stall >= 0.0
+    with open(tmp_path / "sync" / "sync.pk", "rb") as f:
+        sync_blob = f.read()
+    with open(tmp_path / "async" / "async.pk", "rb") as f:
+        async_blob = f.read()
+    assert sync_blob == async_blob
+
+
+def pytest_async_wait_is_a_barrier_at_next_save(tmp_path, monkeypatch):
+    """save() N+1 must not start until write N landed (bounded in-flight of
+    one), and meta is snapshotted at save() time — later caller mutations
+    (the training loop keeps appending to its history dict) must not leak
+    into an in-flight write."""
+    real_write = ckpt_io.write_checkpoint_blob
+    done = []
+
+    def slow_write(path_name, blob):
+        time.sleep(0.15)
+        real_write(path_name, blob)
+        done.append(path_name)
+
+    monkeypatch.setattr(ckpt_io, "write_checkpoint_blob", slow_write)
+    variables, opt_state = _state()
+    meta = {"epoch": 1, "history": {"a": [1.0]}}
+    ac = AsyncCheckpointer()
+    ac.save(variables, opt_state, "bar", path=str(tmp_path) + "/", meta=dict(meta))
+    meta["history"]["a"].append(2.0)  # caller mutates AFTER enqueue
+    assert not done, "first write still in flight"
+    ac.save(variables, opt_state, "bar", path=str(tmp_path) + "/",
+            meta={"epoch": 2, "history": {"a": [1.0, 2.0]}})
+    assert len(done) == 1, "second save() must wait for the first write"
+    ac.close()
+    assert len(done) == 2
+    assert load_checkpoint_meta("bar", path=str(tmp_path) + "/")["epoch"] == 2
+
+
+def pytest_async_writer_failure_reraised_at_wait(tmp_path, monkeypatch):
+    """A writer-thread failure is never swallowed: the next wait point (the
+    next save, an explicit wait(), or close()) re-raises it on the training
+    thread with the original error chained."""
+
+    def boom(path_name, blob):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt_io, "write_checkpoint_blob", boom)
+    variables, opt_state = _state()
+    ac = AsyncCheckpointer()
+    ac.save(variables, opt_state, "err", path=str(tmp_path) + "/")
+    with pytest.raises(RuntimeError, match="NOT persisted") as exc:
+        ac.wait()
+    assert isinstance(exc.value.__cause__, OSError)
+    ac.close()  # already drained; must not raise again or hang
+
+
+def pytest_fault_plan_checkpoint_kinds(tmp_path, monkeypatch):
+    """Grammar + gating of the new drill kinds: corrupt_ckpt@K /
+    truncate_ckpt@K / kill@saveK parse, fire at the scheduled completed-save
+    index, and are incarnation-0 gated (a supervised restart must recover,
+    not re-corrupt its own saves)."""
+    plan = FaultPlan("seed=3,corrupt_ckpt@1,truncate_ckpt@2,kill@save9")
+    assert plan.active
+    assert plan._ckpt_corrupt == {1} and plan._ckpt_truncate == {2}
+    assert plan._kill_saves == {9}
+    target = tmp_path / "t.pk"
+    payload = bytes(range(256)) * 4
+    target.write_bytes(payload)
+    plan.on_checkpoint_saved(str(target))  # save 0: untouched
+    assert target.read_bytes() == payload
+    plan.on_checkpoint_saved(str(target))  # save 1: one byte flipped
+    flipped = target.read_bytes()
+    assert flipped != payload and len(flipped) == len(payload)
+    assert sum(a != b for a, b in zip(flipped, payload)) == 1
+    plan.on_checkpoint_saved(str(target))  # save 2: truncated to half
+    assert target.stat().st_size == len(payload) // 2
+    assert FaultCounters.get("injected_corrupt_ckpt") >= 1
+    assert FaultCounters.get("injected_truncate_ckpt") >= 1
+
+    # Incarnation gating: the same spec in a restarted process is inert.
+    monkeypatch.setenv("HYDRAGNN_RESTART_COUNT", "1")
+    restarted = FaultPlan("corrupt_ckpt@0,truncate_ckpt@0")
+    target.write_bytes(payload)
+    restarted.on_checkpoint_saved(str(target))
+    assert target.read_bytes() == payload
+
+
+def pytest_corrupt_ckpt_drill_resumes_from_fallback_e2e(tmp_path, monkeypatch):
+    """THE acceptance drill, end to end through run_training: a seeded
+    corrupt_ckpt on the run's LAST save (latest + its hard-linked retained
+    twin) leaves a torn latest checkpoint on disk; the resume run's verified
+    loader falls back to the newest intact retained entry (epoch 2), records
+    it in FaultCounters and supervisor.json, and training completes with the
+    restored history prefix."""
+    from hydragnn_tpu.run_training import run_training
+    from tests.deterministic_graph_data import deterministic_graph_data
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("SERIALIZED_DATA_PATH", str(tmp_path))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["Visualization"] = {"create_plots": False}
+    tr = config["NeuralNetwork"]["Training"]
+    tr["num_epoch"] = 3
+    tr["periodic_checkpoint_every"] = 1
+    tr["checkpoint_keep_last_k"] = 3
+    tr["resume"] = 1
+    # Saves: periodic epochs 1,2,3 (indices 0,1,2) then the end-of-run save
+    # (index 3) — the drill corrupts the end-of-run latest.
+    tr["faults"] = "seed=5,corrupt_ckpt@3"
+    for split, cnt in {"train": 24, "test": 8, "validate": 8}.items():
+        p = f"dataset/unit_test_singlehead_{split}"
+        os.makedirs(p, exist_ok=True)
+        deterministic_graph_data(p, number_configurations=cnt)
+        config["Dataset"]["path"][split] = p
+
+    history1 = run_training(dict(config))
+    assert len(history1["total_loss_train"]) == 3
+    from hydragnn_tpu.utils.config_utils import get_log_name_config
+
+    log_name = get_log_name_config(config)
+    ckpt = os.path.join("logs", log_name, log_name + ".pk")
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint_file(
+            {"params": {}, "batch_stats": {}}, ckpt
+        )  # latest really is torn on disk
+
+    before = FaultCounters.get("ckpt_fallback_loads")
+    tr.pop("faults")  # the resume run is clean
+    history2 = run_training(dict(config))
+    # Resumed from the newest intact retained entry (epoch 2), retrained
+    # epoch 2, finished: full-length history whose prefix is run 1's.
+    assert len(history2["total_loss_train"]) == 3
+    np.testing.assert_allclose(
+        history2["total_loss_train"][:2], history1["total_loss_train"][:2]
+    )
+    assert FaultCounters.get("ckpt_fallback_loads") == before + 1
+    assert load_checkpoint_meta(log_name)["epoch"] == 3
+    with open(os.path.join("logs", log_name, "supervisor.json")) as f:
+        events = json.load(f)["checkpoint_fallbacks"]
+    assert events and events[-1]["epoch"] == 2 and events[-1]["epochs_lost"] == 1

@@ -235,18 +235,14 @@ def pytest_batch_mode_composes_with_resume(tmp_path, monkeypatch):
 
     # Rewind the finished checkpoint's meta to epoch 2 (the crash-resume
     # install pattern from tests/test_resume_2proc.py) and resume.
-    import pickle
+    from hydragnn_tpu.checkpoint import update_checkpoint_meta
 
     log = [d for d in os.listdir("logs") if os.path.exists(f"logs/{d}/{d}.pk")][0]
     ckpt = f"logs/{log}/{log}.pk"
-    with open(ckpt, "rb") as f:
-        payload = pickle.load(f)
-    payload["meta"]["epoch"] = 2
-    payload["meta"]["history"] = {
-        k: v[:2] for k, v in payload["meta"]["history"].items()
-    }
-    with open(ckpt, "wb") as f:
-        pickle.dump(payload, f)
+    meta = load_checkpoint_meta(log)
+    meta["epoch"] = 2
+    meta["history"] = {k: v[:2] for k, v in meta["history"].items()}
+    update_checkpoint_meta(ckpt, meta)
 
     history2 = run_training(dict(config))
     assert len(history2["total_loss_train"]) == 4

@@ -21,7 +21,6 @@ state, but the resume control flow under test is identical).
 
 import json
 import os
-import pickle
 import shutil
 import sys
 import threading
@@ -32,6 +31,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import hydragnn_tpu
+from hydragnn_tpu.checkpoint import update_checkpoint_meta
 from hydragnn_tpu.parallel.distributed import barrier, init_comm_size_and_rank
 from hydragnn_tpu.utils.config_utils import get_log_name_config
 from hydragnn_tpu.utils.model import load_checkpoint_meta
@@ -104,16 +104,12 @@ def pytest_resume_2proc():
         if os.path.exists(snapshot):
             os.replace(snapshot, ckpt)
         else:  # machine outran the 50 ms watcher poll
-            with open(ckpt, "rb") as f:
-                payload = pickle.load(f)
-            payload["meta"]["epoch"] = 2
-            payload["meta"]["history"] = {
-                k: v[:2] for k, v in payload["meta"]["history"].items()
-            }
-            tmp = ckpt + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(payload, f)
-            os.replace(tmp, ckpt)
+            meta = load_checkpoint_meta(log_name)
+            meta["epoch"] = 2
+            meta["history"] = {k: v[:2] for k, v in meta["history"].items()}
+            # Format-aware atomic rewrite (the checkpoint is a v2 verified
+            # container now, not a raw pickle).
+            update_checkpoint_meta(ckpt, meta)
     barrier("resume2proc_post_rewind")
     meta = load_checkpoint_meta(log_name)
     assert meta["epoch"] == 2  # every rank sees the mid-run checkpoint
