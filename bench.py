@@ -207,6 +207,7 @@ def _peak_workload():
 def build_production_pipeline(
     batch_size: "int | None" = None,
     training_overrides: "dict | None" = None,
+    dataset_overrides: "dict | None" = None,
 ) -> dict:
     """ci_multihead.json (the north-star multi-task config) through the real
     pipeline: serialized dataset -> bucketed loader (2 shape buckets) ->
@@ -260,6 +261,8 @@ def build_production_pipeline(
         config["NeuralNetwork"]["Training"]["batch_size"] = batch_size
     if training_overrides:
         config["NeuralNetwork"]["Training"].update(training_overrides)
+    if dataset_overrides:
+        config["Dataset"].update(dataset_overrides)
 
     train_loader, val_loader, test_loader, _ = dataset_loading_and_splitting(
         config=config
@@ -498,6 +501,130 @@ def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
         }
 
     return _latest_artifact_block("FAULTS_*.json", extract, search_dir)
+
+
+def _last_known_packing(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent completed train-side packing A/B from any committed
+    BENCH_*_packing artifact — the packing analog of
+    ``_last_known_hardware``. A failed ``--packing`` round embeds this block
+    with ``provenance: "stale"``."""
+
+    def extract(doc):
+        if doc.get("metric") != "train_packing_ab" or not doc.get("value"):
+            return None
+        return {
+            "value": doc.get("value"),
+            "padding_waste_nodes_unpacked": _get_arm(
+                doc, "unpacked", "padding_waste_nodes"
+            ),
+            "padding_waste_nodes_packed": _get_arm(
+                doc, "packed", "padding_waste_nodes"
+            ),
+            "val_loss_rel_diff": doc.get("val_loss_rel_diff"),
+            "backend": doc.get("backend"),
+        }
+
+    return _latest_artifact_block("BENCH_*_packing.json", extract, search_dir)
+
+
+def _get_arm(doc, arm, key):
+    return (doc.get(arm) or {}).get(key)
+
+
+def packing_main() -> int:
+    """``python bench.py --packing``: the train-side packing A/B (ROADMAP
+    item 1) on the production pipeline — ci_multihead through the bucketed
+    loader, same seed, packing off vs on — reporting steady-epoch graphs/sec,
+    measured padding waste from the loader's padded-row accounting, and
+    same-seed convergence parity (final val loss rel-diff). Writes the
+    round's BENCH_rNN_packing.json; failure embeds the last known A/B,
+    stale-labeled, per the established convention."""
+    epochs = 4
+    result = {
+        "metric": "train_packing_ab",
+        "value": 0.0,
+        "unit": "packed_vs_unpacked_graphs_per_sec",
+        "epochs": epochs,
+    }
+    from hydragnn_tpu.utils.artifacts import round_tag
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_r{round_tag()}_packing.json",
+    )
+    try:
+        import jax
+
+        result["backend"] = jax.default_backend()
+        for tag, overrides in (
+            ("unpacked", None),
+            ("packed", {"packing": True}),
+        ):
+            pipe = build_production_pipeline(dataset_overrides=overrides)
+            driver = pipe["driver"]
+            loader = pipe["train_loader"]
+            loader.reset_padding_stats()
+            val_losses = []
+            steady_s = 0.0
+            for epoch in range(epochs):
+                loader.set_epoch(epoch)
+                t0 = time.perf_counter()
+                driver.train_epoch(loader)
+                dt = time.perf_counter() - t0
+                if epoch > 0:
+                    steady_s += dt
+                val_loss, _ = driver.evaluate(pipe["val_loader"])
+                val_losses.append(round(float(val_loss), 6))
+            stats = loader.padding_stats()
+            result[tag] = {
+                "steady_graphs_per_sec": round(
+                    len(loader.dataset) * (epochs - 1) / steady_s, 2
+                ),
+                "batches_per_epoch": len(loader),
+                "padding_waste_nodes": stats["padding_waste_nodes"],
+                "padding_waste_edges": stats["padding_waste_edges"],
+                "padding_waste_graphs": stats["padding_waste_graphs"],
+                "val_loss_curve": val_losses,
+            }
+        up, pk = result["unpacked"], result["packed"]
+        result["value"] = round(
+            pk["steady_graphs_per_sec"] / up["steady_graphs_per_sec"], 3
+        )
+        result["padding_waste_nodes_reduction"] = round(
+            up["padding_waste_nodes"] / max(pk["padding_waste_nodes"], 1e-9), 3
+        )
+        # Same-seed convergence parity: packed batches change membership,
+        # not the objective — final val losses must agree to bench noise
+        # (the tier-1 tolerance test lives in tests/test_packing.py).
+        final_u, final_p = up["val_loss_curve"][-1], pk["val_loss_curve"][-1]
+        result["val_loss_rel_diff"] = round(
+            abs(final_p - final_u) / max(abs(final_u), 1e-9), 4
+        )
+        result["note"] = (
+            "epoch-matched arms: packing raises the effective batch, so the "
+            "packed arm takes fewer optimizer steps per epoch and its loss "
+            "curve lags at equal epochs; the STEP-matched parity gate is "
+            "tests/test_packing.py::"
+            "pytest_packed_training_convergence_parity_same_seed"
+        )
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        result["artifact"] = os.path.basename(out_path)
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        try:
+            stale = _last_known_packing()
+            if stale is not None:
+                result["last_known_packing"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0
 
 
 def faults_main() -> int:
@@ -889,6 +1016,8 @@ if __name__ == "__main__":
         sys.exit(serve_main())
     if "--faults" in sys.argv:
         sys.exit(faults_main())
+    if "--packing" in sys.argv:
+        sys.exit(packing_main())
     if "--analyze" in sys.argv:
         sys.exit(analyze_main())
     main()

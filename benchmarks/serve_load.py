@@ -14,14 +14,27 @@ Two complementary load models:
   reports achieved throughput, rejection count (backpressure), and
   p50/p95/p99 end-to-end latency from a fresh metrics window.
 
-The engine under load is a small PNA (the flagship family) with the request
-pool's worst-case bucket ladder warmed at startup, so the artifact's
-``recompiles_after_warmup`` field directly certifies the steady-state
-"zero recompiles" property. Run on CPU this measures the serving PLUMBING
-(micro-batching, queueing, collation overlap) — per-request latencies are
-not TPU numbers and the artifact labels the platform.
+Since the packing PR this is an **A/B benchmark** (ROADMAP item 1): the same
+workload runs twice —
+
+* **unpacked** — the historical configuration (one worst-case bucket, no
+  packing): the SERVE_r06 arrangement that measured 75–97% padding waste;
+* **packed** — a bucket ladder FITTED from the unpacked arm's recorded size
+  histogram (graphs/packing.fit_ladder — the production feedback loop, see
+  docs/SERVING.md runbook) plus first-fit-decreasing flush packing.
+
+The histogram is written next to the artifact (``SERVE_rNN_hist.json``) so
+``python -m hydragnn_tpu.graphs.packing fit-ladder`` can refit offline, and
+``ab_summary`` carries the padding-waste and graphs/sec deltas the ROADMAP
+gates on. Both arms warm their ladders, so ``recompiles_after_warmup``
+certifies the zero-steady-state-compile property under packing too.
+
+Run on CPU this measures the serving PLUMBING (micro-batching, queueing,
+collation overlap) — per-request latencies are not TPU numbers and the
+artifact labels the platform.
 
     python benchmarks/serve_load.py [--duration 1.5] [--loads 50,200,800]
+        [--no-ab]
 """
 
 from __future__ import annotations
@@ -48,9 +61,12 @@ def build_serving_engine(
     max_delay_ms: float = 3.0,
     queue_limit: int = 1024,
     pool_size: int = 64,
+    bucket_ladder=None,
+    packing: bool = False,
 ):
-    """Small flagship-family engine + a request-graph pool, with the pool's
-    worst-case bucket ladder warmed (one executable serves every batch)."""
+    """Small flagship-family engine + a request-graph pool. Default ladder is
+    the pool's worst-case single bucket (the historical / unpacked arm);
+    pass a fitted ``bucket_ladder`` (+ ``packing=True``) for the packed arm."""
     import __graft_entry__ as ge
     from hydragnn_tpu.graphs import collate_graphs
     from hydragnn_tpu.graphs.collate import compute_pad_sizes
@@ -64,24 +80,31 @@ def build_serving_engine(
     model = ge._build_model(hidden=hidden, layers=layers)
     batch = collate_graphs(graphs[:2], (), (), edge_dim=1)
     variables = init_model_variables(model, batch)
-    n_pad, e_pad, _ = compute_pad_sizes(graphs, max_batch_graphs)
+    if bucket_ladder is None:
+        n_pad, e_pad, _ = compute_pad_sizes(graphs, max_batch_graphs)
+        bucket_ladder = [(n_pad, e_pad)]
     engine = InferenceEngine(
         model,
         variables,
         max_batch_graphs=max_batch_graphs,
         max_delay_ms=max_delay_ms,
         queue_limit=queue_limit,
-        bucket_ladder=[(n_pad, e_pad)],
+        bucket_ladder=bucket_ladder,
         warmup=True,
+        packing=packing,
     )
     return engine, graphs
 
 
-def _fresh_metrics(engine):
-    """Give the engine a fresh metrics window; return the old one."""
+def _fresh_metrics(engine, hist=None):
+    """Give the engine a fresh metrics window; return the old one. ``hist``
+    (a SizeHistogram) accumulates the outgoing window's size observations so
+    per-arm resets don't lose the ladder fitter's input."""
     from hydragnn_tpu.serve import ServeMetrics
 
     old = engine.metrics
+    if hist is not None:
+        hist.merge(old.size_hist)
     engine.metrics = ServeMetrics()
     return old
 
@@ -89,6 +112,8 @@ def _fresh_metrics(engine):
 def _latency_block(engine) -> dict:
     snap = engine.metrics.snapshot()
     e2e = snap["latency_ms"]["e2e"]
+    device = engine.metrics.latency["device"]
+    completed = snap["graphs_total"]
     return {
         "p50_ms": e2e["p50_ms"],
         "p95_ms": e2e["p95_ms"],
@@ -99,12 +124,23 @@ def _latency_block(engine) -> dict:
         "batch_occupancy_mean": snap["batch_occupancy_mean"],
         "padding_waste_nodes_mean": snap["padding_waste_nodes_mean"],
         "padding_waste_edges_mean": snap["padding_waste_edges_mean"],
+        # Device-time capacity at this arm's batch mix: graphs completed per
+        # second of device execution — the chip-throughput this traffic
+        # shape would sustain, independent of the offered rate. THE
+        # graphs/sec lever smaller buckets move at low occupancy.
+        "device_capacity_graphs_per_sec": round(completed / device.sum, 2)
+        if device.sum
+        else None,
+        # Which ladder rungs carried the traffic, and how full they ran.
+        "per_bucket": snap["per_bucket"],
     }
 
 
-def closed_loop(engine, graphs, concurrency: int = 8, duration_s: float = 1.5) -> dict:
+def closed_loop(
+    engine, graphs, concurrency: int = 8, duration_s: float = 1.5, hist=None
+) -> dict:
     """N always-busy workers → saturation throughput."""
-    _fresh_metrics(engine)
+    _fresh_metrics(engine, hist)
     stop = time.perf_counter() + duration_s
     done = [0] * concurrency
 
@@ -137,12 +173,14 @@ def closed_loop(engine, graphs, concurrency: int = 8, duration_s: float = 1.5) -
     }
 
 
-def open_loop(engine, graphs, offered_rps: float, duration_s: float = 1.5) -> dict:
+def open_loop(
+    engine, graphs, offered_rps: float, duration_s: float = 1.5, hist=None
+) -> dict:
     """Fixed-schedule arrivals at ``offered_rps``; rejections (backpressure)
     are counted, not retried — the open-loop contract."""
     from hydragnn_tpu.serve import BackpressureError
 
-    _fresh_metrics(engine)
+    _fresh_metrics(engine, hist)
     interval = 1.0 / offered_rps
     n = max(1, int(duration_s * offered_rps))
     futures = []
@@ -171,61 +209,145 @@ def open_loop(engine, graphs, offered_rps: float, duration_s: float = 1.5) -> di
     }
 
 
+def _run_arm(engine, graphs, duration_s, loads, hist=None) -> dict:
+    """One engine through the full workload (closed + open sweep) under the
+    recompile sentinel; returns the arm's measurement block."""
+    warm_snap = engine.metrics.snapshot()["bucket_cache"]
+    buckets_after_warmup = len(engine._executables)
+    with engine.no_recompile(action="count") as watch:
+        closed = closed_loop(engine, graphs, duration_s=duration_s, hist=hist)
+        open_levels = [
+            open_loop(engine, graphs, rps, duration_s=duration_s, hist=hist)
+            for rps in loads
+        ]
+    _fresh_metrics(engine, hist)  # fold the final window into the record
+    return {
+        "engine": {
+            "max_batch_graphs": engine.max_batch_graphs,
+            "max_delay_ms": engine.max_delay_ms,
+            "queue_limit": engine.queue_limit,
+            "bucket_ladder": engine._ladder,
+            "packing": engine._packing,
+        },
+        "warmup": {
+            "buckets_compiled": warm_snap["misses"],
+            "compile_seconds": warm_snap["compile_seconds"],
+        },
+        # Executable-cache growth since warmup — robust to the per-level
+        # metrics-window resets above: any steady-state compile adds an
+        # entry to the engine-lifetime cache.
+        "recompiles_after_warmup": len(engine._executables)
+        - buckets_after_warmup,
+        # XLA-level corroboration from the recompile sentinel: counts EVERY
+        # backend compile during the measured load, engine-cache or not.
+        "xla_compiles_during_load": watch.count,
+        "saturation_graphs_per_sec": closed["achieved_graphs_per_sec"],
+        "closed_loop": closed,
+        "open_loop": open_levels,
+    }
+
+
+def _ratio(a, b):
+    return round(a / b, 3) if a and b else None
+
+
+def _ab_summary(unpacked: dict, packed: dict) -> dict:
+    """The deltas ROADMAP item 1 gates on, per arm: padding-waste reduction
+    (unpacked/packed, >1 is better) and graphs/sec speedups — saturation
+    (closed loop) and device-time capacity at each open-loop arm's traffic
+    shape (achieved open-loop throughput tracks the OFFERED rate below
+    saturation, so capacity is the honest per-arm graphs/sec lever)."""
+    out = {
+        "saturation_speedup": _ratio(
+            packed["saturation_graphs_per_sec"],
+            unpacked["saturation_graphs_per_sec"],
+        ),
+        "open_loop": [],
+    }
+    for arm_u, arm_p in zip(unpacked["open_loop"], packed["open_loop"]):
+        out["open_loop"].append(
+            {
+                "offered_graphs_per_sec": arm_u["offered_graphs_per_sec"],
+                "batch_occupancy_unpacked": arm_u["batch_occupancy_mean"],
+                "padding_waste_nodes_reduction": _ratio(
+                    arm_u["padding_waste_nodes_mean"],
+                    arm_p["padding_waste_nodes_mean"],
+                ),
+                "padding_waste_edges_reduction": _ratio(
+                    arm_u["padding_waste_edges_mean"],
+                    arm_p["padding_waste_edges_mean"],
+                ),
+                "device_capacity_speedup": _ratio(
+                    arm_p["device_capacity_graphs_per_sec"],
+                    arm_u["device_capacity_graphs_per_sec"],
+                ),
+                "p50_speedup": _ratio(arm_u["p50_ms"], arm_p["p50_ms"]),
+            }
+        )
+    return out
+
+
 def run_serve_benchmark(
     duration_s: float = 1.5,
     loads=(50.0, 200.0, 800.0),
     out_path: "str | None" = None,
+    ab: bool = True,
+    max_rungs: int = 6,
 ) -> dict:
     import jax
 
+    from hydragnn_tpu.graphs.packing import SizeHistogram, fit_ladder
+
+    hist = SizeHistogram()
+    # Arm A — unpacked: the historical single worst-case bucket (SERVE_r06).
     engine, graphs = build_serving_engine()
-    warm_snap = engine.metrics.snapshot()["bucket_cache"]
-    buckets_after_warmup = len(engine._executables)
     try:
-        # Recompile sentinel (analysis/sentinel.py) over the measured load:
-        # action="count" so the watch CORROBORATES the cache-growth field
-        # below at the XLA level without failing the benchmark — the two
-        # must agree at 0 for a valid steady-state measurement.
-        with engine.no_recompile(action="count") as watch:
-            closed = closed_loop(engine, graphs, duration_s=duration_s)
-            open_levels = [
-                open_loop(engine, graphs, rps, duration_s=duration_s)
-                for rps in loads
-            ]
-        block = {
-            "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "platform": jax.default_backend(),
-            "device_kind": jax.devices()[0].device_kind,
-            "engine": {
-                "model": "PNA hidden=8 x2 (graph+node heads)",
-                "max_batch_graphs": engine.max_batch_graphs,
-                "max_delay_ms": engine.max_delay_ms,
-                "queue_limit": engine.queue_limit,
-                "bucket_ladder": engine._ladder,
-            },
-            "warmup": {
-                "buckets_compiled": warm_snap["misses"],
-                "compile_seconds": warm_snap["compile_seconds"],
-            },
-            # Executable-cache growth since warmup — robust to the per-level
-            # metrics-window resets above: any steady-state compile adds an
-            # entry to the engine-lifetime cache.
-            "recompiles_after_warmup": len(engine._executables)
-            - buckets_after_warmup,
-            # XLA-level corroboration from the recompile sentinel: counts
-            # EVERY backend compile during the measured load, engine-cache
-            # or not.
-            "xla_compiles_during_load": watch.count,
-            "saturation_graphs_per_sec": closed["achieved_graphs_per_sec"],
-            "closed_loop": closed,
-            "open_loop": open_levels,
-            "note": "CPU runs measure serving plumbing (batching/queueing/"
-            "collation overlap), not TPU latency",
-        }
+        unpacked = _run_arm(engine, graphs, duration_s, loads, hist=hist)
     finally:
         engine.close()
+
     if out_path is None:
         out_path = os.path.join(REPO, f"SERVE_r{round_tag()}.json")
+    hist_path = os.path.splitext(out_path)[0] + "_hist.json"
+
+    block = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": "PNA hidden=8 x2 (graph+node heads)",
+        "note": "CPU runs measure serving plumbing (batching/queueing/"
+        "collation overlap), not TPU latency",
+    }
+    if not ab:
+        block.update(unpacked)
+        block["engine"]["model"] = block.pop("model")
+        with open(out_path, "w") as f:
+            json.dump(block, f, indent=2)
+        block["artifact"] = os.path.basename(out_path)
+        return block
+
+    # The feedback loop: fit the packed arm's ladder from the sizes the
+    # unpacked arm OBSERVED (exactly what an operator does from production
+    # histograms — docs/SERVING.md runbook), and persist the histogram so
+    # the fit is reproducible offline via the fit-ladder CLI.
+    hist.save(hist_path)
+    ladder = fit_ladder(hist, max_rungs=max_rungs)
+
+    # Arm B — packed: fitted ladder + first-fit-decreasing flush packing.
+    engine, graphs = build_serving_engine(bucket_ladder=ladder, packing=True)
+    try:
+        packed = _run_arm(engine, graphs, duration_s, loads)
+    finally:
+        engine.close()
+
+    # Headline fields mirror the packed arm (the configuration this PR
+    # ships), with the unpacked arm and the deltas alongside.
+    block.update(packed)
+    block["engine"]["model"] = block.pop("model")
+    block["fitted_ladder"] = [list(r) for r in ladder]
+    block["histogram_artifact"] = os.path.basename(hist_path)
+    block["unpacked"] = unpacked
+    block["ab_summary"] = _ab_summary(unpacked, packed)
     with open(out_path, "w") as f:
         json.dump(block, f, indent=2)
     block["artifact"] = os.path.basename(out_path)
@@ -237,10 +359,20 @@ def main() -> int:
     ap.add_argument("--duration", type=float, default=1.5)
     ap.add_argument("--loads", default="50,200,800")
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--no-ab",
+        action="store_true",
+        help="single unpacked arm only (the pre-packing artifact shape)",
+    )
+    ap.add_argument("--max-rungs", type=int, default=6)
     args = ap.parse_args()
     loads = tuple(float(v) for v in args.loads.split(",") if v.strip())
     block = run_serve_benchmark(
-        duration_s=args.duration, loads=loads, out_path=args.out
+        duration_s=args.duration,
+        loads=loads,
+        out_path=args.out,
+        ab=not args.no_ab,
+        max_rungs=args.max_rungs,
     )
     print(json.dumps(block))
     return 0

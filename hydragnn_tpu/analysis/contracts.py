@@ -69,7 +69,7 @@ _SHAPE_CACHE: Dict[Tuple[str, str], Tuple[list, list, Any]] = {}
 def check_config(
     config,
     mode: str = "training",
-    bucket_ladder: Optional[Sequence[Tuple[int, int]]] = None,
+    bucket_ladder: "Optional[Sequence[Tuple[int, int]] | str]" = None,
     strict: bool = True,
     deep: bool = True,
 ) -> Dict[str, Any]:
@@ -77,7 +77,9 @@ def check_config(
     dict; with ``strict`` (the default) raises :class:`ConfigContractError`
     on any violation instead. ``deep=False`` skips the ``jax.eval_shape``
     pass (structural checks only — the entry points use this when
-    ``HYDRAGNN_CHECK_CONFIG=structural``)."""
+    ``HYDRAGNN_CHECK_CONFIG=structural``). ``bucket_ladder`` accepts parsed
+    ``(N_pad, E_pad)`` rungs or any CLI spec string — ``"NxE,..."`` or
+    ``"auto:<path>"`` (resolved via graphs/packing.resolve_ladder_spec)."""
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
@@ -366,6 +368,38 @@ def _check_buckets(config, arch, training, bucket_ladder, mode, errors):
         errors.append(
             ("oob-bucket", f"Dataset.num_buckets {nb!r} must be an int >= 1")
         )
+    ls = _get(config, "Dataset", "ladder_step")
+    if ls is not None and ls not in ("pow2", "mult64"):
+        errors.append(
+            (
+                "oob-bucket",
+                f"Dataset.ladder_step {ls!r} must be 'pow2' or 'mult64' "
+                "(the pad round-up ladder, graphs/packing.round_up_step)",
+            )
+        )
+    pk = _get(config, "Dataset", "packing")
+    if pk is not None and not isinstance(pk, bool):
+        errors.append(
+            ("oob-bucket", f"Dataset.packing {pk!r} must be a bool")
+        )
+    if isinstance(bucket_ladder, str):
+        # Spec forms ("NxE,..." literal, "auto:<histogram-or-ladder.json>")
+        # resolve through ONE parser so CLI and checker can never disagree;
+        # any resolution failure (bad literal, missing/garbled auto file,
+        # empty histogram) is an actionable oob-bucket line here instead of
+        # a stack trace after the checkpoint loaded.
+        from ..graphs.packing import resolve_ladder_spec
+
+        try:
+            bucket_ladder = resolve_ladder_spec(bucket_ladder)
+        except Exception as e:  # noqa: BLE001 — every parse error is a finding
+            errors.append(
+                (
+                    "oob-bucket",
+                    f"bucket ladder spec {bucket_ladder!r} is invalid: {e}",
+                )
+            )
+            bucket_ladder = None
     if bucket_ladder is not None:
         num_nodes = arch.get("num_nodes")
         best_n = 0

@@ -143,6 +143,7 @@ COLLATION_DETERMINISTIC_MODULES = (
     "graphs/collate.py",
     "graphs/batch.py",
     "graphs/sample.py",
+    "graphs/packing.py",
     "preprocess/dataloader.py",
     "preprocess/splitting.py",
 )

@@ -1,3 +1,15 @@
 from .batch import GraphBatch
 from .sample import GraphSample
 from .collate import collate_graphs, compute_pad_sizes, unpack_targets, round_up_pow2
+from .packing import (
+    PackCaps,
+    SizeHistogram,
+    first_fit_decreasing,
+    fit_ladder,
+    resolve_ladder_spec,
+)
+
+# NOTE: `python -m hydragnn_tpu.graphs.packing fit-ladder` prints a runpy
+# double-import RuntimeWarning on stderr (the package root imports the
+# preprocess layer, which already pulled in graphs.packing before runpy
+# executes it). Harmless: the CLI's JSON contract is stdout-only.

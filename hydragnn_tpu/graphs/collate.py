@@ -18,10 +18,18 @@ from .batch import GraphBatch
 from .sample import GraphSample
 
 
-def round_up_pow2(n: int, minimum: int = 8) -> int:
-    """Round up to the next power of two (≥ minimum) to bound XLA recompiles."""
-    v = max(int(n), minimum)
-    return 1 << (v - 1).bit_length()
+def round_up_pow2(n: int, minimum: int = 8, mode: str = "pow2") -> int:
+    """Round up to the next compiled-shape boundary (≥ minimum) to bound XLA
+    recompiles. ``mode="pow2"`` (default) is the historical next-power-of-two
+    ladder; ``mode="mult64"`` switches to multiples of 64 above 256 so a
+    520-node batch pads to 576 instead of 1024 (``Dataset.ladder_step`` in
+    the JSON config; graphs/packing.py:round_up_step holds the arithmetic)."""
+    if mode == "pow2":
+        v = max(int(n), minimum)
+        return 1 << (v - 1).bit_length()
+    from .packing import round_up_step
+
+    return round_up_step(n, minimum=minimum, mode=mode)
 
 
 def unpack_targets(
@@ -286,13 +294,14 @@ class GraphArena:
 
 
 def compute_pad_sizes(
-    graphs: Sequence[GraphSample], batch_size: int
+    graphs: Sequence[GraphSample], batch_size: int, ladder_step: str = "pow2"
 ) -> Tuple[int, int, int]:
     """Dataset-level static pad sizes so every batch of ``batch_size`` graphs from
     this dataset fits one compiled shape: a worst-case batch is the ``batch_size``
-    largest graphs."""
+    largest graphs. ``ladder_step`` picks the round-up ladder (see
+    ``round_up_pow2``)."""
     nodes = sorted((s.num_nodes for s in graphs), reverse=True)[:batch_size]
     edges = sorted((s.num_edges for s in graphs), reverse=True)[:batch_size]
-    n_pad = round_up_pow2(sum(nodes) + 1)
-    e_pad = round_up_pow2(max(sum(edges), 1) + 1)
+    n_pad = round_up_pow2(sum(nodes) + 1, mode=ladder_step)
+    e_pad = round_up_pow2(max(sum(edges), 1) + 1, mode=ladder_step)
     return n_pad, e_pad, batch_size + 1

@@ -1,0 +1,477 @@
+"""Graph packing + occupancy-aware bucket ladders (ROADMAP item 1).
+
+The padded-arena contract compiles one executable per ``(N_pad, E_pad,
+G_pad)`` bucket — but a bucket sized for the worst-case batch burns most of
+the chip on padding when traffic is small (SERVE_r06: occupancy 0.06–0.5,
+padding waste 75–97% of nodes/edges). This module is the shared layer both
+hot paths use to stop that:
+
+* :func:`first_fit_decreasing` — bin-pack many small graphs into one arena
+  slot under joint ``(nodes, edges, graphs)`` capacity constraints BEFORE
+  padding, so each compiled batch carries more real rows. Used by the
+  serving micro-batcher (``serve/engine.py``) and the training collator plan
+  (``preprocess/dataloader.py``).
+* :class:`SizeHistogram` — per-run record of observed graph and batch sizes
+  (serve metrics layer + training loader), serialized to JSON so production
+  observations feed the next deploy's ladder.
+* :func:`fit_ladder` — derive a small set of ``(N_pad, E_pad)`` bucket
+  shapes from an observed size histogram under a bounded compile budget
+  (``max_rungs``), minimizing expected padded-row waste instead of rounding
+  everything to the next power of two.
+* :func:`resolve_ladder_spec` — one parser for every ladder form the CLIs
+  accept: ``"NxE,NxE"`` literals, ``auto:<histogram.json>`` (fit now), and
+  ``auto:<ladder.json>`` (pre-fitted, e.g. by ``fit-ladder`` below).
+
+CLI::
+
+    python -m hydragnn_tpu.graphs.packing fit-ladder --hist HIST.json \
+        [--max-rungs 4] [--mode mult64] [--out LADDER.json]
+
+Everything here is deterministic by contract (graftlint's
+collation-deterministic rule applies): no wall clock, no global RNG —
+batches must be a pure function of (dataset, seed, epoch) or crash-resume
+replay and the device-cache epochs diverge from the streamed path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+HISTOGRAM_SCHEMA = "hydragnn-size-histogram/v1"
+LADDER_SCHEMA = "hydragnn-bucket-ladder/v1"
+
+# Default compile budget for fitted ladders: each rung is one XLA compile at
+# warmup (~tens of seconds each on the bucketed path, BENCH_r05_hw), so the
+# fitter trades padding waste against a handful of executables, not dozens.
+DEFAULT_MAX_RUNGS = 4
+
+LADDER_STEP_MODES = ("pow2", "mult64")
+
+
+# --------------------------------------------------------------------- packer
+@dataclasses.dataclass(frozen=True)
+class PackCaps:
+    """Joint capacity of ONE arena slot (one padded batch).
+
+    ``nodes``/``edges`` are REAL-row capacities: the padded batch needs
+    ``N_pad > total nodes`` (>= 1 padding node is always reserved), so a slot
+    destined for shape ``(N_pad, E_pad)`` has ``nodes = N_pad - 1`` and
+    ``edges = E_pad``. ``graphs`` caps bin cardinality so ``G_pad`` stays a
+    static compiled dimension.
+    """
+
+    nodes: int
+    edges: int
+    graphs: int
+
+    def fits(self, n: int, e: int, g: int = 1) -> bool:
+        return n <= self.nodes and e <= self.edges and g <= self.graphs
+
+
+def first_fit_decreasing(
+    node_sizes: Sequence[int],
+    edge_sizes: Sequence[int],
+    caps: PackCaps,
+    order: Optional[Sequence[int]] = None,
+) -> List[List[int]]:
+    """Pack items (graphs) into bins (arena slots) by first-fit-decreasing.
+
+    Items are visited largest-first (by nodes, then edges) and each placed
+    into the FIRST open bin with room under every capacity; no fit opens a
+    new bin. Returns bins as lists of item indices, in bin-creation order.
+
+    ``order`` is an optional permutation of item indices used as the scan
+    order among EQUAL-size items (and the within-bin emission order): callers
+    with a per-epoch shuffle pass it so ties rotate across epochs while the
+    packing itself stays deterministic in (sizes, order).
+
+    An item exceeding ``caps`` on its own is returned as a singleton bin —
+    the caller's fallback path (pow2 round-up) owns oversize graphs; packing
+    must never drop or reorder them out of existence.
+    """
+    ns = np.asarray(node_sizes, dtype=np.int64)
+    es = np.asarray(edge_sizes, dtype=np.int64)
+    if ns.shape != es.shape or ns.ndim != 1:
+        raise ValueError("node_sizes and edge_sizes must be equal-length 1-D")
+    count = len(ns)
+    if order is None:
+        order = np.arange(count, dtype=np.int64)
+    else:
+        order = np.asarray(order, dtype=np.int64)
+        if sorted(order.tolist()) != list(range(count)):
+            raise ValueError("order must be a permutation of range(len(items))")
+    # Decreasing by (nodes, edges); ties follow the caller's order. Sorting
+    # the caller-ordered items with a stable sort gives exactly that.
+    rank = np.lexsort((-es[order], -ns[order]))
+    visit = order[rank]
+
+    bins: List[List[int]] = []
+    bin_nodes: List[int] = []
+    bin_edges: List[int] = []
+    for i in visit.tolist():
+        n, e = int(ns[i]), int(es[i])
+        if not caps.fits(n, e):
+            bins.append([i])  # oversize: isolated, caller falls back
+            bin_nodes.append(n)
+            bin_edges.append(e)
+            continue
+        for b, members in enumerate(bins):
+            if (
+                bin_nodes[b] + n <= caps.nodes
+                and bin_edges[b] + e <= caps.edges
+                and len(members) < caps.graphs
+                # An oversize singleton is CLOSED: feeding it more graphs
+                # would push the fallback shape even further past the ladder.
+                and caps.fits(bin_nodes[b], bin_edges[b])
+            ):
+                members.append(i)
+                bin_nodes[b] += n
+                bin_edges[b] += e
+                break
+        else:
+            bins.append([i])
+            bin_nodes.append(n)
+            bin_edges.append(e)
+    return bins
+
+
+# ------------------------------------------------------------------ histogram
+class SizeHistogram:
+    """Joint size counts for graphs and batches, JSON-serializable.
+
+    ``graphs``: {(nodes, edges): count} of individual graphs (requests /
+    dataset samples). ``batches``: {(nodes, edges, graphs): count} of REAL
+    batch totals at collation time — what the ladder fitter consumes. Counts
+    are plain ints; recording is O(1) per observation.
+    """
+
+    def __init__(self):
+        self.graphs: Dict[Tuple[int, int], int] = {}
+        self.batches: Dict[Tuple[int, int, int], int] = {}
+
+    def record_graph(self, nodes: int, edges: int, weight: int = 1) -> None:
+        key = (int(nodes), int(edges))
+        self.graphs[key] = self.graphs.get(key, 0) + int(weight)
+
+    def record_batch(
+        self, nodes: int, edges: int, graphs: int, weight: int = 1
+    ) -> None:
+        key = (int(nodes), int(edges), int(graphs))
+        self.batches[key] = self.batches.get(key, 0) + int(weight)
+
+    @property
+    def num_graphs(self) -> int:
+        return sum(self.graphs.values())
+
+    @property
+    def num_batches(self) -> int:
+        return sum(self.batches.values())
+
+    def merge(self, other: "SizeHistogram") -> "SizeHistogram":
+        for (n, e), w in other.graphs.items():
+            self.record_graph(n, e, w)
+        for (n, e, g), w in other.batches.items():
+            self.record_batch(n, e, g, w)
+        return self
+
+    # -- serialization (sorted keys => byte-stable files for identical data)
+    def to_json(self) -> dict:
+        return {
+            "schema": HISTOGRAM_SCHEMA,
+            "graph_sizes": [
+                [n, e, w] for (n, e), w in sorted(self.graphs.items())
+            ],
+            "batch_sizes": [
+                [n, e, g, w] for (n, e, g), w in sorted(self.batches.items())
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "SizeHistogram":
+        if doc.get("schema") != HISTOGRAM_SCHEMA:
+            raise ValueError(
+                f"not a size histogram (schema {doc.get('schema')!r}, "
+                f"expected {HISTOGRAM_SCHEMA!r})"
+            )
+        hist = cls()
+        for n, e, w in doc.get("graph_sizes", ()):
+            hist.record_graph(n, e, w)
+        for n, e, g, w in doc.get("batch_sizes", ()):
+            hist.record_batch(n, e, g, w)
+        return hist
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+
+    @classmethod
+    def load(cls, path: str) -> "SizeHistogram":
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+
+# -------------------------------------------------------------- ladder fitter
+def round_up_step(
+    n: int, minimum: int = 8, mode: str = "pow2", step: int = 64
+) -> int:
+    """Round a size up to a compiled-shape boundary.
+
+    ``mode="pow2"``: next power of two (the historical ladder — at most 2x
+    waste, but a 520-node batch pads to 1024). ``mode="mult64"``: next power
+    of two up to ``4*step`` (tiny shapes stay sparse), then the next multiple
+    of ``step`` — a 520-node batch pads to 576, and 64 is the TPU lane width
+    so every rung stays tiling-aligned.
+    """
+    if mode not in LADDER_STEP_MODES:
+        raise ValueError(
+            f"unknown ladder-step mode {mode!r} (expected one of "
+            f"{LADDER_STEP_MODES})"
+        )
+    v = max(int(n), int(minimum))
+    p = 1 << (v - 1).bit_length()
+    if mode == "pow2" or p <= 4 * step:
+        return p
+    return -(-v // step) * step
+
+
+def fit_ladder(
+    hist: "SizeHistogram | Sequence[Tuple[int, int, int]]",
+    max_rungs: int = DEFAULT_MAX_RUNGS,
+    mode: str = "mult64",
+    step: int = 64,
+    min_nodes: int = 8,
+) -> List[Tuple[int, int]]:
+    """Fit an occupancy-aware bucket ladder to observed batch sizes.
+
+    Input is a :class:`SizeHistogram` (its ``batches`` table; single-graph
+    ``graphs`` observations stand in when no batches were recorded — the
+    1-request flush shape) or a raw ``[(nodes, edges, weight)]`` sequence.
+    Returns at most ``max_rungs`` ``(N_pad, E_pad)`` shapes, ascending, with
+    ``E_pad`` non-decreasing alongside ``N_pad`` so the TOP rung dominates
+    every observation — the packers' capacity guarantee.
+
+    Method: exact weighted interval DP over the (quantized) sorted node
+    totals. Splitting the observations into K contiguous segments, each
+    segment's rung is the rounded-up segment maximum and its cost is the
+    weighted padded-node waste ``sum_i w_i * (N_seg - n_i)``; the DP picks
+    the K-segmentation minimizing total waste. Edge pads are the rounded-up
+    per-segment edge maxima (cummax'd) — edges ride the node segmentation
+    because node counts drive both in molecular graphs, and an edge overflow
+    still resolves to a higher rung at batch time rather than an error.
+    """
+    if isinstance(hist, SizeHistogram):
+        rows = [(n, e, w) for (n, e, g), w in sorted(hist.batches.items())]
+        if not rows:
+            rows = [(n, e, w) for (n, e), w in sorted(hist.graphs.items())]
+    else:
+        rows = [(int(n), int(e), int(w)) for n, e, w in hist]
+    rows = [(n, e, w) for n, e, w in rows if w > 0]
+    if not rows:
+        raise ValueError("cannot fit a ladder from an empty histogram")
+    max_rungs = max(1, int(max_rungs))
+
+    # Aggregate per unique node total; carry max-edges and summed weight.
+    by_n: Dict[int, List[int]] = {}
+    for n, e, w in rows:
+        cur = by_n.setdefault(n, [0, 0])
+        cur[0] += w
+        cur[1] = max(cur[1], e)
+    ns = np.array(sorted(by_n), dtype=np.int64)
+    ws = np.array([by_n[int(n)][0] for n in ns], dtype=np.float64)
+    es = np.array([by_n[int(n)][1] for n in ns], dtype=np.int64)
+
+    # Bound the DP: quantize to at most 512 support points by merging each
+    # chunk into its maximum (conservative: rungs only grow, never shrink).
+    if len(ns) > 512:
+        chunks = np.array_split(np.arange(len(ns)), 512)
+        ns = np.array([ns[c].max() for c in chunks])
+        ws = np.array([ws[c].sum() for c in chunks])
+        es = np.array([es[c].max() for c in chunks])
+
+    m = len(ns)
+    k = min(max_rungs, m)
+    w_pref = np.concatenate([[0.0], np.cumsum(ws)])
+    wn_pref = np.concatenate([[0.0], np.cumsum(ws * ns)])
+
+    def seg_cost(i: int, j: int) -> float:
+        """Weighted padded-node waste of one rung covering ns[i..j]."""
+        rung = round_up_step(int(ns[j]) + 1, minimum=min_nodes, mode=mode, step=step)
+        return rung * (w_pref[j + 1] - w_pref[i]) - (wn_pref[j + 1] - wn_pref[i])
+
+    inf = float("inf")
+    dp = np.full((k + 1, m + 1), inf)
+    cut = np.zeros((k + 1, m + 1), dtype=np.int64)
+    dp[0][0] = 0.0
+    for r in range(1, k + 1):
+        for j in range(1, m + 1):
+            for i in range(r - 1, j):
+                c = dp[r - 1][i] + seg_cost(i, j - 1)
+                if c < dp[r][j]:
+                    dp[r][j] = c
+                    cut[r][j] = i
+    # Fewer segments can never cost less here (each rung is a segment max),
+    # but rungs can COLLIDE after rounding — dedup below handles that.
+    bounds = []
+    j = m
+    for r in range(k, 0, -1):
+        i = int(cut[r][j])
+        bounds.append((i, j - 1))
+        j = i
+    bounds.reverse()
+
+    ladder: List[Tuple[int, int]] = []
+    e_floor = 0
+    for i, j in bounds:
+        n_pad = round_up_step(int(ns[j]) + 1, minimum=min_nodes, mode=mode, step=step)
+        e_pad = round_up_step(
+            max(int(es[i : j + 1].max()), 1), minimum=min_nodes, mode=mode, step=step
+        )
+        e_floor = max(e_floor, e_pad)  # cummax: top rung dominates on edges
+        if ladder and ladder[-1][0] == n_pad:
+            ladder[-1] = (n_pad, max(ladder[-1][1], e_floor))
+        else:
+            ladder.append((n_pad, e_floor))
+    return ladder
+
+
+def ladder_waste(
+    ladder: Sequence[Tuple[int, int]],
+    hist: "SizeHistogram | Sequence[Tuple[int, int, int]]",
+) -> float:
+    """Mean padded-node waste fraction of ``hist``'s batches under ``ladder``
+    (tightest-fitting rung per batch; oversize batches fall back pow2) —
+    the fitter's objective, exposed for reporting and tests."""
+    if isinstance(hist, SizeHistogram):
+        rows = [(n, e, w) for (n, e, g), w in sorted(hist.batches.items())]
+        if not rows:
+            rows = [(n, e, w) for (n, e), w in sorted(hist.graphs.items())]
+    else:
+        rows = list(hist)
+    rungs = sorted((int(n), int(e)) for n, e in ladder)
+    total_w = total_waste = 0.0
+    for n, e, w in rows:
+        n_pad = next(
+            (rn for rn, re in rungs if rn > n and re >= e),
+            round_up_step(n + 1, mode="pow2"),
+        )
+        total_w += w
+        total_waste += w * (1.0 - n / n_pad)
+    return total_waste / total_w if total_w else 0.0
+
+
+# ----------------------------------------------------------------- spec forms
+def parse_ladder_literal(spec: str) -> List[Tuple[int, int]]:
+    """``"512x4096,1024x8192"`` → ``[(512, 4096), (1024, 8192)]``."""
+    ladder = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        n, _, e = part.partition("x")
+        if not e:
+            raise ValueError(
+                f"bucket ladder rung {part!r} is not of the form NxE"
+            )
+        ladder.append((int(n), int(e)))
+    if not ladder:
+        raise ValueError(f"empty bucket ladder spec {spec!r}")
+    return ladder
+
+
+def resolve_ladder_spec(
+    spec: str,
+    max_rungs: int = DEFAULT_MAX_RUNGS,
+    mode: str = "mult64",
+) -> List[Tuple[int, int]]:
+    """Resolve any CLI/config ladder form to ``[(N_pad, E_pad)]``.
+
+    * ``"NxE,NxE,..."`` — literal shapes, as before.
+    * ``"auto:<path>"`` — ``<path>`` is either a fitted ladder JSON (the
+      ``fit-ladder`` CLI output: its ladder is used verbatim) or a size
+      histogram JSON (a ladder is fitted NOW with the given budget).
+    """
+    if spec.startswith("auto:"):
+        path = spec[len("auto:") :]
+        if not path:
+            raise ValueError("auto: ladder spec is missing the file path")
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") == LADDER_SCHEMA:
+            ladder = [(int(n), int(e)) for n, e in doc["ladder"]]
+            if not ladder:
+                raise ValueError(f"{path}: fitted ladder is empty")
+            return ladder
+        return fit_ladder(
+            SizeHistogram.from_json(doc), max_rungs=max_rungs, mode=mode
+        )
+    return parse_ladder_literal(spec)
+
+
+def ladder_to_json(
+    ladder: Sequence[Tuple[int, int]], meta: Optional[dict] = None
+) -> dict:
+    return {
+        "schema": LADDER_SCHEMA,
+        "ladder": [[int(n), int(e)] for n, e in ladder],
+        "meta": dict(meta or {}),
+    }
+
+
+# ------------------------------------------------------------------------ CLI
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.graphs.packing",
+        description="Graph-packing utilities (docs/SERVING.md runbook).",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    fit = sub.add_parser(
+        "fit-ladder",
+        help="fit an occupancy-aware bucket ladder from a size histogram",
+    )
+    fit.add_argument(
+        "--hist",
+        required=True,
+        help="size-histogram JSON (serve: SERVE_rNN_hist.json; training: "
+        "logs/<name>/size_histogram.json)",
+    )
+    fit.add_argument("--max-rungs", type=int, default=DEFAULT_MAX_RUNGS)
+    fit.add_argument("--mode", choices=LADDER_STEP_MODES, default="mult64")
+    fit.add_argument("--step", type=int, default=64)
+    fit.add_argument(
+        "--out",
+        default=None,
+        help="write the fitted ladder JSON here (default: stdout only); "
+        "consumed by --bucket-ladder auto:<path>",
+    )
+    args = ap.parse_args(argv)
+
+    hist = SizeHistogram.load(args.hist)
+    ladder = fit_ladder(
+        hist, max_rungs=args.max_rungs, mode=args.mode, step=args.step
+    )
+    doc = ladder_to_json(
+        ladder,
+        meta={
+            "source": args.hist,
+            "max_rungs": args.max_rungs,
+            "mode": args.mode,
+            "step": args.step,
+            "observed_batches": hist.num_batches,
+            "observed_graphs": hist.num_graphs,
+            "mean_padding_waste_nodes": round(ladder_waste(ladder, hist), 4),
+        },
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

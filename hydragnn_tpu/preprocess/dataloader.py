@@ -23,6 +23,7 @@ import numpy as np
 
 from ..graphs.batch import GraphBatch
 from ..graphs.collate import GraphArena, compute_pad_sizes
+from ..graphs.packing import PackCaps, SizeHistogram, first_fit_decreasing
 from ..graphs.sample import GraphSample
 
 
@@ -89,6 +90,8 @@ class GraphDataLoader:
         reshuffle: str = "sample",
         skip_budget: int = 0,
         fault_plan=None,
+        packing: bool = False,
+        ladder_step: str = "pow2",
     ):
         """``reshuffle`` picks the per-epoch shuffling granularity:
 
@@ -111,6 +114,18 @@ class GraphDataLoader:
         default 0 performs no validation at all — identical to the
         historical loader. ``fault_plan`` (default: HYDRAGNN_FAULTS env)
         injects seeded sample corruption for the drills.
+
+        ``packing=True`` (``Dataset.packing``) bin-packs graphs into arena
+        slots by first-fit-decreasing (graphs/packing.py) instead of cutting
+        the shuffled stream every ``batch_size`` graphs: a batch then holds
+        as many graphs as fit the bucket's node/edge capacity (up to 4x
+        ``batch_size``), so streamed epochs run far fewer, far denser padded
+        batches. Batch MEMBERSHIP becomes size-driven (ties and batch order
+        still reshuffle per epoch) — a mild SGD semantics change like
+        ``reshuffle="batch"``, which is why it is opt-in; same-seed
+        convergence parity is locked by tests/test_packing.py.
+        ``ladder_step`` picks the pad round-up ladder (``"pow2"`` historical,
+        ``"mult64"``: multiples of 64 above 256 — docs/INPUT_PIPELINE.md).
         """
         if reshuffle not in ("sample", "batch"):
             raise ValueError(
@@ -131,6 +146,8 @@ class GraphDataLoader:
         self.head_dims = tuple(head_dims) if head_dims else None
         self.edge_dim = edge_dim
         self.reshuffle = reshuffle
+        self.packing = bool(packing)
+        self.ladder_step = ladder_step
         self.epoch = 0
         # Head-spec generation: bumped by set_head_spec so EXTERNAL caches of
         # collated/device batches (TrainingDriver._scan_cache/_eval_cache)
@@ -140,6 +157,7 @@ class GraphDataLoader:
         self.generation = 0
         self._arena = None
         self._frozen_plan = None  # reshuffle="batch": membership drawn once
+        self._plan_memo = None  # (epoch, generation) -> last computed plan
         self._batch_cache: dict = {}  # plan position -> collated GraphBatch
         # Host-RAM cap for the collation cache (padded batches can be several
         # times the raw dataset): once exceeded, later positions are simply
@@ -151,7 +169,21 @@ class GraphDataLoader:
             _os.environ.get("HYDRAGNN_HOST_CACHE_MB", "1024")
         ) * (1 << 20)
         self._cache_bytes = 0
-        self._build_buckets(max(1, int(num_buckets)))
+        # Per-sample size arrays (packing + per-batch accounting) and the
+        # per-run size record the ladder fitter consumes
+        # (``python -m hydragnn_tpu.graphs.packing fit-ladder``).
+        self._ns = np.fromiter(
+            (s.num_nodes for s in self.dataset), np.int64, len(self.dataset)
+        )
+        self._es = np.fromiter(
+            (s.num_edges for s in self.dataset), np.int64, len(self.dataset)
+        )
+        self.size_histogram = SizeHistogram()
+        for n, e in zip(self._ns.tolist(), self._es.tolist()):
+            self.size_histogram.record_graph(n, e)
+        self._pad_stats = self._zero_pad_stats()
+        self._num_buckets_requested = max(1, int(num_buckets))
+        self._build_buckets(self._num_buckets_requested)
 
     def _apply_fault_plan(self, fault_plan) -> None:
         """Seeded corrupt-sample injection (the quarantine drill). Runs
@@ -198,8 +230,9 @@ class GraphDataLoader:
         if n == 0:
             self._buckets = []
             self._bucket_pads = []
+            self._pack_caps = []
             return
-        sizes = np.array([s.num_nodes for s in self.dataset])
+        sizes = self._ns  # one source of truth for per-sample node counts
         num_buckets = min(num_buckets, n)
         order = np.argsort(sizes, kind="stable")
         splits = np.array_split(order, num_buckets)
@@ -219,9 +252,33 @@ class GraphDataLoader:
         # eval-loader guarantee documented in load_data.create_dataloaders).
         self._buckets = [np.sort(b) for b in buckets]
         self._bucket_pads = [
-            compute_pad_sizes([self.dataset[i] for i in b], self.batch_size)
+            compute_pad_sizes(
+                [self.dataset[i] for i in b],
+                self.batch_size,
+                ladder_step=self.ladder_step,
+            )
             for b in self._buckets
         ]
+        # Packing: the bucket's worst-case pad shape becomes a CAPACITY the
+        # packer fills with however many graphs fit (bounded at 4x batch_size
+        # so G_pad stays a sane static dimension); G_pad grows to the graph
+        # capacity + the reserved padding graph.
+        self._pack_caps = []
+        if self.packing:
+            pads = []
+            for b, (n_pad, e_pad, _) in zip(self._buckets, self._bucket_pads):
+                min_n = max(1, int(sizes[b].min()))
+                g_cap = int(
+                    min(
+                        max(self.batch_size, (n_pad - 1) // min_n),
+                        4 * self.batch_size,
+                    )
+                )
+                self._pack_caps.append(
+                    PackCaps(nodes=n_pad - 1, edges=e_pad, graphs=g_cap)
+                )
+                pads.append((n_pad, e_pad, g_cap + 1))
+            self._bucket_pads = pads
 
     # -- reference parity: sampler.set_epoch reshuffles DP shards each epoch.
     def set_epoch(self, epoch: int) -> None:
@@ -236,6 +293,57 @@ class GraphDataLoader:
         self._batch_cache.clear()  # cached collations baked the old spec
         self._cache_bytes = 0
         self.generation += 1  # external (driver) caches key on this
+
+    def set_packing(
+        self, enabled: bool, ladder_step: Optional[str] = None
+    ) -> None:
+        """Toggle graph packing (and optionally the round-up ladder) after
+        construction: rebuilds bucket pads/capacities, drops cached
+        collations and the frozen plan, and bumps ``generation`` so external
+        caches of collated/device batches (TrainingDriver scan/eval caches)
+        detect the shape change — the same invalidation contract as
+        ``set_head_spec``."""
+        self.packing = bool(enabled)
+        if ladder_step is not None:
+            self.ladder_step = ladder_step
+        self._frozen_plan = None
+        self._batch_cache.clear()
+        self._cache_bytes = 0
+        self.generation += 1
+        self._build_buckets(self._num_buckets_requested)
+
+    @staticmethod
+    def _zero_pad_stats() -> dict:
+        return {
+            "batches": 0,
+            "real_nodes": 0,
+            "pad_nodes": 0,
+            "real_edges": 0,
+            "pad_edges": 0,
+            "real_graphs": 0,
+            "pad_graphs": 0,
+        }
+
+    def reset_padding_stats(self) -> None:
+        self._pad_stats = self._zero_pad_stats()
+
+    def padding_stats(self) -> dict:
+        """Padded-row accounting over every batch yielded since the last
+        reset: waste = share of compiled rows that carried no real
+        node/edge/graph (the serving metrics' ``padding_waste_*`` definition,
+        on the training side). Surfaced by ``bench.py --packing``."""
+        st = dict(self._pad_stats)
+        for kind in ("nodes", "edges", "graphs"):
+            pad = st[f"pad_{kind}"]
+            st[f"padding_waste_{kind}"] = (
+                round(1.0 - st[f"real_{kind}"] / pad, 4) if pad else None
+            )
+        return st
+
+    def write_size_histogram(self, path: str) -> None:
+        """Persist this run's observed sizes for the ladder fitter
+        (``python -m hydragnn_tpu.graphs.packing fit-ladder --hist <path>``)."""
+        self.size_histogram.save(path)
 
     @property
     def pad_sizes(self):
@@ -269,15 +377,29 @@ class GraphDataLoader:
         reshuffle="batch": membership drawn ONCE from rng(seed) and frozen
         (plan_pos is a stable identity — the collation cache and the
         driver's device cache key on it); only the visit ORDER reshuffles
-        per epoch."""
+        per epoch.
+
+        The plan is a pure function of (epoch, generation), so it is
+        memoized per epoch: ``__len__`` + ``__iter__`` in the same epoch
+        pay the shuffle/packing planning cost once (the FFD packer is
+        O(items x bins) Python — cheap at this framework's host-RAM dataset
+        sizes, but not free to re-run casually)."""
+        key = (self.epoch, self.generation)
+        if self._plan_memo is not None and self._plan_memo[0] == key:
+            return self._plan_memo[1]
+        plan = self._compute_batch_plan()
+        self._plan_memo = (key, plan)
+        return plan
+
+    def _compute_batch_plan(self) -> List[tuple]:
         if self.reshuffle == "batch" and self.shuffle:
             if self._frozen_plan is None:
                 rng = np.random.default_rng(self.seed)
                 plan = []
                 for bi, bucket in enumerate(self._buckets):
                     idx = self._shard(np.asarray(bucket), rng)
-                    for start in range(0, len(idx), self.batch_size):
-                        plan.append((bi, idx[start : start + self.batch_size]))
+                    for members in self._plan_bucket(bi, idx):
+                        plan.append((bi, members))
                 self._frozen_plan = [
                     (pos, bi, idx) for pos, (bi, idx) in enumerate(plan)
                 ]
@@ -293,11 +415,29 @@ class GraphDataLoader:
         plan = []
         for bi, bucket in enumerate(self._buckets):
             idx = self._shard(np.asarray(bucket), rng)
-            for start in range(0, len(idx), self.batch_size):
-                plan.append((bi, idx[start : start + self.batch_size]))
-        if rng is not None and len(self._buckets) > 1:
+            for members in self._plan_bucket(bi, idx):
+                plan.append((bi, members))
+        # Packed plans come out of FFD largest-bin-first; restore random
+        # visit order (multi-bucket plans always reshuffled, as before).
+        if rng is not None and (len(self._buckets) > 1 or self.packing):
             rng.shuffle(plan)
         return [(None, bi, idx) for bi, idx in plan]
+
+    def _plan_bucket(self, bi: int, idx: np.ndarray) -> List[np.ndarray]:
+        """Split one bucket's (sharded, shuffled) index stream into batch
+        membership arrays: fixed ``batch_size`` cuts, or — with packing —
+        first-fit-decreasing bins under the bucket's (nodes, edges, graphs)
+        capacity. The shuffled ``idx`` order is the packer's tie-break, so
+        equal-size graphs still migrate between batches across epochs."""
+        if not self.packing:
+            return [
+                idx[start : start + self.batch_size]
+                for start in range(0, len(idx), self.batch_size)
+            ]
+        bins = first_fit_decreasing(
+            self._ns[idx], self._es[idx], self._pack_caps[bi]
+        )
+        return [idx[members] for members in bins]
 
     def __len__(self) -> int:
         return len(self._batch_plan())
@@ -309,10 +449,24 @@ class GraphDataLoader:
             # caps a prefetch thread well below TPU consumption rate).
             self._arena = GraphArena(self.dataset)
         for pos, bi, sample_idx in self._batch_plan():
+            n_pad, e_pad, g_pad = self._bucket_pads[bi]
+            # Per-batch size record + padded-row accounting (cached yields
+            # included — the device executes the same padded shape either
+            # way). Feeds the ladder fitter and bench.py --packing.
+            tot_n = int(self._ns[sample_idx].sum())
+            tot_e = int(self._es[sample_idx].sum())
+            self.size_histogram.record_batch(tot_n, tot_e, len(sample_idx))
+            st = self._pad_stats
+            st["batches"] += 1
+            st["real_nodes"] += tot_n
+            st["pad_nodes"] += n_pad
+            st["real_edges"] += tot_e
+            st["pad_edges"] += e_pad
+            st["real_graphs"] += len(sample_idx)
+            st["pad_graphs"] += g_pad
             if pos is not None and pos in self._batch_cache:
                 yield self._batch_cache[pos]
                 continue
-            n_pad, e_pad, g_pad = self._bucket_pads[bi]
             batch = self._arena.collate(
                 sample_idx,
                 head_types=self.head_types or (),

@@ -49,11 +49,17 @@ def dataset_loading_and_splitting(config: Dict):
         # no validation, the historical behavior.
         skip_budget=config["Dataset"].get("skip_budget", 0),
         fault_plan=fault_plan,
+        # Graph packing + pad round-up ladder (docs/INPUT_PIPELINE.md
+        # "Graph packing"): packing densifies train batches by FFD
+        # bin-packing; ladder_step picks pow2 vs multiples-of-64 pads.
+        packing=bool(config["Dataset"].get("packing", False)),
+        ladder_step=config["Dataset"].get("ladder_step", "pow2"),
     )
 
 
 def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1,
-                       reshuffle="sample", skip_budget=0, fault_plan=None):
+                       reshuffle="sample", skip_budget=0, fault_plan=None,
+                       packing=False, ladder_step="pow2"):
     """Three GraphDataLoaders; multi-process runs shard every split by process
     (the DistributedSampler analog). Returns (train, val, test, sampler_list) for
     reference API parity — the loaders are their own samplers here.
@@ -97,6 +103,11 @@ def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1,
                 reshuffle=reshuffle if shuffle else "sample",
                 skip_budget=skip_budget,
                 fault_plan=fault_plan,
+                # Packing reorders batch membership by size — train only;
+                # eval loaders must keep exact dataset order
+                # (run_prediction rows align with the test set).
+                packing=packing if shuffle else False,
+                ladder_step=ladder_step,
             )
         )
     train_loader, val_loader, test_loader = loaders

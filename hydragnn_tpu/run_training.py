@@ -276,6 +276,14 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
         history=prior_history,
     )
 
+    if world_rank == 0 and hasattr(train_loader, "write_size_histogram"):
+        # Per-run size record for the ladder fitter (docs/SERVING.md
+        # "Fitting a ladder from production histograms"): refit with
+        # python -m hydragnn_tpu.graphs.packing fit-ladder --hist <file>.
+        train_loader.write_size_histogram(
+            "./logs/" + log_name + "/size_histogram.json"
+        )
+
     if viz is not None:
         # Final test pass for the latest predictions; denormalize first when
         # requested (reference train_validate_test.py:141-163).

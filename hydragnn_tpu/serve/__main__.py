@@ -13,13 +13,13 @@ from .engine import InferenceEngine
 from .server import InferenceServer
 
 
-def parse_ladder(spec: str):
-    """--bucket-ladder "512x4096,1024x8192" → [(512, 4096), (1024, 8192)]."""
-    ladder = []
-    for part in filter(None, (p.strip() for p in spec.split(","))):
-        n, e = part.split("x")
-        ladder.append((int(n), int(e)))
-    return ladder
+def parse_ladder(spec: str, max_rungs: int = 4):
+    """--bucket-ladder "512x4096,1024x8192" → [(512, 4096), (1024, 8192)];
+    --bucket-ladder auto:<path> loads a fitted ladder JSON or fits one from
+    a size-histogram JSON now (graphs/packing.resolve_ladder_spec)."""
+    from ..graphs.packing import resolve_ladder_spec
+
+    return resolve_ladder_spec(spec, max_rungs=max_rungs)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -51,8 +51,31 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--bucket-ladder",
         default="",
-        help='comma-separated "NxE" padded shapes, e.g. "512x4096,1024x8192"; '
-        "compiled at startup unless --no-warmup",
+        help='comma-separated "NxE" padded shapes, e.g. "512x4096,1024x8192", '
+        'or "auto:<path>" where <path> is a size-histogram JSON '
+        "(logs/<name>/size_histogram.json, SERVE_rNN_hist.json) or a "
+        "fit-ladder output JSON; compiled at startup unless --no-warmup",
+    )
+    ap.add_argument(
+        "--max-ladder-rungs",
+        type=int,
+        default=4,
+        help="compile budget when --bucket-ladder auto: fits from a "
+        "histogram (ignored for literal and pre-fitted ladders)",
+    )
+    ap.add_argument(
+        "--packing",
+        action="store_true",
+        help="bin-pack each flushed micro-batch under the top ladder rung "
+        "(first-fit-decreasing) so over-capacity flushes split into "
+        "tightest-rung bins instead of falling back to a worst-case shape",
+    )
+    ap.add_argument(
+        "--ladder-step",
+        choices=("pow2", "mult64"),
+        default="pow2",
+        help="round-up ladder for shapes that miss the bucket ladder: "
+        "mult64 pads a 520-node batch to 576 instead of 1024",
     )
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument(
@@ -75,13 +98,38 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    ladder = parse_ladder(args.bucket_ladder) if args.bucket_ladder else None
     # Static contract gate (docs/STATIC_ANALYSIS.md): a broken completed
-    # config or an infeasible bucket ladder is one actionable line at
-    # startup, not a mid-warmup stack trace after the checkpoint loaded.
+    # config or an infeasible/unparseable bucket ladder — including the
+    # auto:<path> form — is one actionable line at startup, not a mid-warmup
+    # stack trace after the checkpoint loaded. The spec is resolved ONCE,
+    # with the CLI's rung budget, and the checker validates the rungs that
+    # will actually deploy; only when resolution itself fails does the RAW
+    # spec go to the checker, whose own resolution failure becomes the
+    # actionable oob-bucket line.
     from ..analysis.contracts import gate_config
 
-    gate_config(args.config, mode="serving", bucket_ladder=ladder)
+    ladder = None
+    parse_error = None
+    if args.bucket_ladder:
+        try:
+            ladder = parse_ladder(
+                args.bucket_ladder, max_rungs=args.max_ladder_rungs
+            )
+        except Exception as e:  # noqa: BLE001 — checker diagnoses it below
+            parse_error = e
+    gate_config(
+        args.config,
+        mode="serving",
+        bucket_ladder=ladder
+        if ladder is not None
+        else (args.bucket_ladder or None),
+    )
+    if parse_error is not None:
+        # The gate normally turns a bad spec into one actionable oob-bucket
+        # line — but it honors HYDRAGNN_CHECK_CONFIG=off. An explicit
+        # operator flag must never be silently dropped, so if the gate let
+        # the broken spec through, the original parse failure still aborts.
+        raise parse_error
     engine = InferenceEngine.from_config(
         args.config,
         checkpoint=args.ckpt,
@@ -91,6 +139,8 @@ def main(argv=None) -> int:
         queue_limit=args.queue_limit,
         bucket_ladder=ladder,
         warmup=not args.no_warmup,
+        packing=args.packing,
+        ladder_step=args.ladder_step,
         max_worker_restarts=args.max_worker_restarts,
         guard_outputs=not args.no_output_guard,
     )

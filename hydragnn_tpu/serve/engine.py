@@ -52,6 +52,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..graphs.collate import GraphArena, round_up_pow2
+from ..graphs.packing import PackCaps, first_fit_decreasing
 from ..graphs.sample import GraphSample
 from ..train.pipeline import DeviceFeed
 from .metrics import ServeMetrics
@@ -158,9 +159,23 @@ class InferenceEngine:
     bucket_ladder:
         Optional sequence of ``(N_pad, E_pad)`` shapes. A batch takes the
         smallest ladder entry it fits; only when none fits does it fall back
-        to the pow2 round-up (counted as ``ladder_fallback_total``). With
+        to the round-up ladder (counted as ``ladder_fallback_total``). With
         ``warmup=True`` every ladder entry is compiled at construction, so
-        steady-state traffic never recompiles.
+        steady-state traffic never recompiles. Fit one from observed traffic
+        with ``graphs/packing.py fit_ladder`` (CLI: ``--bucket-ladder
+        auto:<histogram-or-ladder.json>``).
+    packing:
+        Bin-pack each flushed micro-batch by first-fit-decreasing under the
+        TOP ladder rung's (nodes, edges) capacity (graphs/packing.py): an
+        over-capacity flush splits into several bins that each take their
+        tightest rung instead of one batch falling back to a worst-case
+        round-up shape. Per-request identity is preserved — every bin
+        carries its own requests and node offsets through to response
+        demux. No-op without a ladder.
+    ladder_step:
+        Round-up ladder for shapes that miss the bucket ladder: ``"pow2"``
+        (historical) or ``"mult64"`` (multiples of 64 above 256 — a
+        520-node batch pads to 576, not 1024).
     head_names, y_minmax:
         Optional per-head names and min-max pairs; with ``y_minmax`` set,
         outputs are denormalized (``v * (ymax - ymin) + ymin``, the
@@ -190,6 +205,8 @@ class InferenceEngine:
         queue_limit: int = 256,
         bucket_ladder: Optional[Sequence[Tuple[int, int]]] = None,
         warmup: bool = False,
+        packing: bool = False,
+        ladder_step: str = "pow2",
         head_names: Optional[Sequence[str]] = None,
         y_minmax: Optional[Sequence] = None,
         metrics: Optional[ServeMetrics] = None,
@@ -217,6 +234,8 @@ class InferenceEngine:
         self._ladder = sorted(
             (int(n), int(e)) for n, e in (bucket_ladder or ())
         )
+        self._packing = bool(packing)
+        self._ladder_step = ladder_step
 
         self._params = jax.device_put(variables["params"])
         self._bstats = jax.device_put(variables.get("batch_stats", {}))
@@ -354,6 +373,7 @@ class InferenceEngine:
             )
             return req.future
         self.metrics.count("requests_total")
+        self.metrics.record_request(sample.num_nodes, sample.num_edges)
         return req.future
 
     def predict(
@@ -492,33 +512,53 @@ class InferenceEngine:
                     saw_shutdown = True
                     break
                 entries.append(nxt)
-            try:
-                work = self._collate(entries)
-            except Exception as e:  # noqa: BLE001
-                # A bad batch (collation failure past _validate's checks)
-                # fails ITS requests loudly but must not poison the engine —
-                # batch-mates and later traffic are innocent.
-                for req in entries:
-                    self._reject(req, e)
-                self.metrics.count("errors_total")
-                self.metrics.count("bad_batches_total")
-                self._degraded = True
-                work = None
-            if work is not None:
+            for group in self._pack_groups(entries):
+                try:
+                    work = self._collate(group)
+                except Exception as e:  # noqa: BLE001
+                    # A bad batch (collation failure past _validate's
+                    # checks) fails ITS requests loudly but must not poison
+                    # the engine — batch-mates and later traffic are
+                    # innocent. Under packing the scope is one BIN: sibling
+                    # bins of the same flush still serve.
+                    for req in group:
+                        self._reject(req, e)
+                    self.metrics.count("errors_total")
+                    self.metrics.count("bad_batches_total")
+                    self._degraded = True
+                    continue
                 yield work
             if saw_shutdown:
                 return
 
+    def _pack_groups(self, entries: List[_Request]) -> List[List[_Request]]:
+        """Split one flush into arena-slot bins (first-fit-decreasing under
+        the top ladder rung's capacity) when packing is on; otherwise the
+        flush is one bin, the historical behavior. Every request of the
+        flush appears in exactly one bin (demux identity is per-bin)."""
+        if not (self._packing and self._ladder):
+            return [entries]
+        top_n, top_e = self._ladder[-1]
+        caps = PackCaps(
+            nodes=top_n - 1, edges=top_e, graphs=self.max_batch_graphs
+        )
+        bins = first_fit_decreasing(
+            [r.sample.num_nodes for r in entries],
+            [r.sample.num_edges for r in entries],
+            caps,
+        )
+        return [[entries[i] for i in members] for members in bins]
+
     def _bucket_shape(self, tot_nodes: int, tot_edges: int) -> Tuple[int, int, bool]:
-        """Smallest ladder (N_pad, E_pad) the batch fits, else pow2 fallback.
-        collate requires N_pad > tot_nodes (>=1 padding node) and
-        E_pad >= tot_edges."""
+        """Smallest ladder (N_pad, E_pad) the batch fits, else round-up
+        fallback (``ladder_step`` mode). collate requires N_pad > tot_nodes
+        (>=1 padding node) and E_pad >= tot_edges."""
         for n, e in self._ladder:
             if n > tot_nodes and e >= tot_edges:
                 return n, e, False
         return (
-            round_up_pow2(tot_nodes + 1),
-            round_up_pow2(max(tot_edges, 1)),
+            round_up_pow2(tot_nodes + 1, mode=self._ladder_step),
+            round_up_pow2(max(tot_edges, 1), mode=self._ladder_step),
             bool(self._ladder),
         )
 

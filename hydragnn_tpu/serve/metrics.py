@@ -18,8 +18,9 @@ value, which is the resolution serving SLOs are stated at.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..graphs.packing import SizeHistogram
 from ..utils.time_utils import Timer
 
 # 100 µs .. ~1638 s in 2x steps (25 bounds) — covers queue waits on an idle
@@ -142,6 +143,15 @@ class ServeMetrics:
         self._occupancy_sum = 0.0
         self._node_fill_sum = 0.0
         self._edge_fill_sum = 0.0
+        # Per-bucket occupancy: the same accumulators keyed by the padded
+        # (N_pad, E_pad) shape the batch compiled into, so a ladder's rungs
+        # are individually observable (which rungs carry traffic, which
+        # waste it) — docs/SERVING.md "Metrics reference".
+        self._per_bucket: Dict[Tuple[int, int], Dict[str, float]] = {}
+        # Observed request/batch sizes: the feedback record the ladder
+        # fitter consumes (graphs/packing.py fit_ladder; dump via
+        # histogram_json()). Guarded by the same lock as the counters.
+        self.size_hist = SizeHistogram()
 
     # ------------------------------------------------------------- recorders
     def observe(self, stage: str, seconds: float) -> None:
@@ -158,6 +168,12 @@ class ServeMetrics:
             self.compile_seconds_total += seconds
         Timer.credit("serve_compile", seconds)
 
+    def record_request(self, num_nodes: int, num_edges: int) -> None:
+        """One admitted request's graph size — the serve half of the size
+        histogram (the training half lives on GraphDataLoader)."""
+        with self._lock:
+            self.size_hist.record_graph(num_nodes, num_edges)
+
     def record_batch(
         self,
         num_graphs: int,
@@ -173,6 +189,15 @@ class ServeMetrics:
             self._occupancy_sum += num_graphs / max(max_batch_graphs, 1)
             self._node_fill_sum += real_nodes / max(n_pad, 1)
             self._edge_fill_sum += real_edges / max(e_pad, 1)
+            bucket = self._per_bucket.setdefault(
+                (int(n_pad), int(e_pad)),
+                {"batches": 0, "graphs": 0, "node_fill": 0.0, "edge_fill": 0.0},
+            )
+            bucket["batches"] += 1
+            bucket["graphs"] += num_graphs
+            bucket["node_fill"] += real_nodes / max(n_pad, 1)
+            bucket["edge_fill"] += real_edges / max(e_pad, 1)
+            self.size_hist.record_batch(real_nodes, real_edges, num_graphs)
 
     # -------------------------------------------------------------- reporters
     def snapshot(self) -> Dict:
@@ -211,9 +236,31 @@ class ServeMetrics:
                 )
                 if batches
                 else None,
+                # Per compiled (N_pad, E_pad) shape: which ladder rungs carry
+                # the traffic and how full they run.
+                "per_bucket": {
+                    f"{n}x{e}": {
+                        "batches": int(b["batches"]),
+                        "graphs": int(b["graphs"]),
+                        "node_fill_mean": round(
+                            b["node_fill"] / b["batches"], 4
+                        ),
+                        "edge_fill_mean": round(
+                            b["edge_fill"] / b["batches"], 4
+                        ),
+                    }
+                    for (n, e), b in sorted(self._per_bucket.items())
+                },
             }
         out["latency_ms"] = {s: h.snapshot() for s, h in self.latency.items()}
         return out
+
+    def histogram_json(self) -> Dict:
+        """The observed-size record (requests + collated batch totals) in the
+        ``fit-ladder`` CLI's input schema — the production feedback loop of
+        docs/SERVING.md "Fitting a ladder from production histograms"."""
+        with self._lock:
+            return self.size_hist.to_json()
 
     def render_prometheus(self) -> str:
         """Prometheus text-format exposition (the /metrics payload)."""
@@ -256,6 +303,22 @@ class ServeMetrics:
             if v is not None:
                 lines.append(f"# TYPE {p}_{gauge} gauge")
                 lines.append(f"{p}_{gauge} {v}")
+        if snap.get("per_bucket"):
+            # One contiguous sample group per metric family (the exposition
+            # format requires all of a metric's samples directly under its
+            # TYPE line — interleaving families breaks strict parsers).
+            lines.append(f"# TYPE {p}_bucket_batches_total counter")
+            for key, b in snap["per_bucket"].items():
+                lines.append(
+                    f'{p}_bucket_batches_total{{bucket="{key}"}} '
+                    f"{b['batches']}"
+                )
+            lines.append(f"# TYPE {p}_bucket_node_fill_mean gauge")
+            for key, b in snap["per_bucket"].items():
+                lines.append(
+                    f'{p}_bucket_node_fill_mean{{bucket="{key}"}} '
+                    f"{b['node_fill_mean']}"
+                )
         lines.append(f"# TYPE {p}_latency_seconds histogram")
         for stage, hist in self.latency.items():
             lines.extend(
