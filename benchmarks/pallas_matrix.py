@@ -95,11 +95,23 @@ def _thresholds():
     return THRESHOLDS
 
 
-# Aggregation arms pin BOTH gates: with the sorted path defaulting ON for
-# TPU execution (ops/segment_sorted.sorted_enabled), an arm that set only
-# HYDRAGNN_PALLAS would silently measure the sorted path on hardware.
+# Aggregation arms pin ALL THREE gates: with the sorted path defaulting ON
+# for TPU execution (ops/segment_sorted.sorted_enabled), an arm that set only
+# HYDRAGNN_PALLAS would silently measure the sorted path on hardware — and
+# with the CSR run-walk kernel defaulting on under HYDRAGNN_PALLAS whenever
+# row_ptr is present (PR 7), the "pallas" arm pins HYDRAGNN_PALLAS_CSR=0 so
+# it still measures the legacy one-hot kernel; "csr" is the new-kernel arm.
 _ARMS = {
-    "pallas": {"HYDRAGNN_PALLAS": "1", "HYDRAGNN_SEGMENT_SORTED": "0"},
+    "pallas": {
+        "HYDRAGNN_PALLAS": "1",
+        "HYDRAGNN_SEGMENT_SORTED": "0",
+        "HYDRAGNN_PALLAS_CSR": "0",
+    },
+    "csr": {
+        "HYDRAGNN_PALLAS": "1",
+        "HYDRAGNN_SEGMENT_SORTED": "0",
+        "HYDRAGNN_PALLAS_CSR": "1",
+    },
     "sorted": {"HYDRAGNN_PALLAS": "0", "HYDRAGNN_SEGMENT_SORTED": "1"},
     "xla": {"HYDRAGNN_PALLAS": "0", "HYDRAGNN_SEGMENT_SORTED": "0"},
 }

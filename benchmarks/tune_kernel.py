@@ -34,8 +34,10 @@ from hydragnn_tpu.ops.pallas_segment import certify_pallas, _BE
 r = certify_pallas(
     e=int(sys.argv[1]), f=int(sys.argv[2]), n=int(sys.argv[3]), contiguous=True,
     # The sorted arm does not read _BE/SKIP, so sweeping re-measures nothing:
-    # only the first arm times it (scarce tunnel minutes).
+    # only the first arm times it (scarce tunnel minutes). The CSR run-walk
+    # kernel DOES read _BE/_BN, so --csr re-measures it per candidate.
     sorted_arm=os.environ.get("HYDRAGNN_TUNE_SORTED") == "1",
+    csr_arm=os.environ.get("HYDRAGNN_TUNE_CSR") == "1",
 )
 r["be"] = _BE
 print("RESULT " + json.dumps(r))
@@ -53,6 +55,12 @@ def main():
         "--skip", choices=("off", "on", "both"), default="off",
         help="sweep the block-skip variant (HYDRAGNN_PALLAS_SKIP) per "
         "candidate: off / on / both arms",
+    )
+    ap.add_argument(
+        "--csr", action="store_true",
+        help="also sweep the CSR run-walk kernel (the row_ptr batch "
+        "contract, ops/pallas_segment.csr_segment_sum_count) per candidate "
+        "— the arm for the next hardware batch",
     )
     ap.add_argument(
         "--cpu", action="store_true",
@@ -78,6 +86,7 @@ def main():
             HYDRAGNN_PALLAS="1",
             HYDRAGNN_PALLAS_SKIP=skip,
             HYDRAGNN_TUNE_SORTED="1" if first else "0",
+            HYDRAGNN_TUNE_CSR="1" if args.csr else "0",
         )
         first = False
         if args.cpu:
@@ -131,6 +140,16 @@ def main():
                 "sorted_ms": r.get("sorted_ms"),
                 "sorted_ok": r.get("sorted_ok"),
                 "sorted_speedup_vs_xla": r.get("sorted_speedup_vs_xla"),
+                # Fourth arm (--csr): the CSR run-walk kernel, swept per
+                # candidate — it reads the same _BE/_BN block geometry.
+                "csr_ms": r.get("csr_ms"),
+                "csr_ok": r.get("csr_ok"),
+                "csr_errs": {
+                    k: r.get(k) for k in ("csr_err_fwd", "csr_err_grad")
+                }
+                if args.csr
+                else None,
+                "csr_speedup_vs_xla": r.get("csr_speedup_vs_xla"),
             }
         )
         print(json.dumps(rows[-1]), flush=True)

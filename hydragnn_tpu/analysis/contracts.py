@@ -98,6 +98,7 @@ def check_config(
     _check_dtype(arch, errors)
     _check_buckets(config, arch, training, bucket_ladder, mode, errors)
     _check_donation(training, errors)
+    _check_aggregation_path(arch, errors)
 
     eval_shape_s = None
     if not errors and not deep:
@@ -443,6 +444,41 @@ def _check_buckets(config, arch, training, bucket_ladder, mode, errors):
         )
 
 
+# ---------------------------------------------------------- aggregation path
+def _check_aggregation_path(arch, errors):
+    """Reject configs whose resolved conv family cannot ride the sorted/CSR
+    edge layout (models/convs.py:SORTED_PATH_FAMILIES). On TPU the sorted
+    path is the DEFAULT (ops/segment_sorted.sorted_enabled) — a family
+    outside the registry would silently fall back to the unsorted XLA
+    scatter path, the exact regression class BENCH_r05 measured at 0.47x.
+    Every shipped family is registered since PR 7 (GAT joined via the
+    self-term rework); this check exists so a future family cannot land
+    half-ported without an explicit opt-out."""
+    import os
+
+    mt = arch.get("model_type")
+    if mt is None:
+        return  # missing-field already reported
+    from ..models.base import CONV_TYPES
+    from ..models.convs import SORTED_PATH_FAMILIES
+
+    if mt not in CONV_TYPES:
+        return  # bad-arch surfaces at model build; don't double-report
+    if mt in SORTED_PATH_FAMILIES:
+        return
+    if os.environ.get("HYDRAGNN_SEGMENT_SORTED") in ("0", "false", "False"):
+        return  # the sorted path is explicitly pinned off — scatter is intended
+    errors.append(
+        (
+            "bad-arch",
+            f"model_type {mt!r} is not registered in SORTED_PATH_FAMILIES "
+            "(models/convs.py): on TPU its aggregation would silently fall "
+            "back to the unsorted scatter path — register the family's "
+            "sorted/CSR aggregation or pin HYDRAGNN_SEGMENT_SORTED=0",
+        )
+    )
+
+
 # ------------------------------------------------------------------- donation
 def _check_donation(training, errors):
     if str(training.get("optimizer", "")).upper() == "LBFGS" and int(
@@ -542,6 +578,24 @@ def _check_shapes(config, arch, voi, training, mode, completed, errors, skipped)
         input_dim, output_dim, output_type, edge_dim=edge_dim,
         num_nodes=num_nodes,
     )
+    # CSR batch contract (graphs/csr.py): the example batch carries the same
+    # row pointers production collation emits — validate length, endpoints,
+    # monotonicity, and agreement with the sorted receivers HERE, so a
+    # collation/layout regression fails the config gate before any compile.
+    from ..graphs.csr import validate_csr
+
+    try:
+        validate_csr(
+            np.asarray(example.receivers), np.asarray(example.row_ptr),
+            example.node_features.shape[0], what="receivers",
+        )
+        validate_csr(
+            np.asarray(example.node_graph), np.asarray(example.graph_ptr),
+            example.num_graphs_pad, what="node_graph",
+        )
+    except ValueError as e:
+        errors.append(("shape-mismatch", str(e)))
+        return round(time.perf_counter() - t0, 4)
     batch_sds = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
         example,

@@ -142,6 +142,7 @@ GUARD_PATH_FUNCTIONS = frozenset({"_keep_if", "_all_finite"})
 COLLATION_DETERMINISTIC_MODULES = (
     "graphs/collate.py",
     "graphs/batch.py",
+    "graphs/csr.py",
     "graphs/sample.py",
     "graphs/packing.py",
     "preprocess/dataloader.py",

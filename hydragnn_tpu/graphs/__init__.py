@@ -1,6 +1,7 @@
 from .batch import GraphBatch
 from .sample import GraphSample
 from .collate import collate_graphs, compute_pad_sizes, unpack_targets, round_up_pow2
+from .csr import build_graph_ptr, build_row_ptr, validate_csr
 from .packing import (
     PackCaps,
     SizeHistogram,

@@ -43,6 +43,14 @@ class GraphBatch:
       graph_mask:     [G_pad] bool — True for real graphs.
       targets:        tuple, one entry per head: graph-level heads are
                       [G_pad, dim]; node-level heads are [N_pad, dim].
+      row_ptr:        [N_pad + 1] int32 or None — CSR boundaries over the
+                      destination-sorted ``receivers`` (graphs/csr.py):
+                      ``row_ptr[n]`` is the first edge targeting node >= n.
+                      Computed once per batch at collation so the sorted-path
+                      segment ops consume precomputed boundaries instead of
+                      re-searching ids every layer.
+      graph_ptr:      [G_pad + 1] int32 or None — the same boundaries over
+                      ``node_graph`` (node→graph readout pooling).
       num_graphs_pad: static python int (G_pad). Needed as a static segment count.
     """
 
@@ -55,6 +63,8 @@ class GraphBatch:
     edge_mask: jnp.ndarray
     graph_mask: jnp.ndarray
     targets: Tuple[jnp.ndarray, ...] = ()
+    row_ptr: Optional[jnp.ndarray] = None
+    graph_ptr: Optional[jnp.ndarray] = None
     num_graphs_pad: int = struct.field(pytree_node=False, default=0)
 
     @property

@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .batch import GraphBatch
+from .csr import build_graph_ptr, build_row_ptr, csr_debug_enabled, validate_csr
 from .sample import GraphSample
 
 
@@ -154,6 +155,11 @@ class GraphArena:
             self.ei_all = self.ei_all[:, order]
             if self.ea_all is not None:
                 self.ea_all = self.ea_all[order]
+        # CSR batch contract (graphs/csr.py): the sort above is what makes
+        # every collated batch's receivers globally non-decreasing, so the
+        # row pointers collate() emits are valid. Validated ONCE per arena
+        # (first collate) — or every batch under HYDRAGNN_DEBUG_LAYOUT=1.
+        self._csr_validated = False
 
         # Unlabeled datasets (inference-only: y/y_loc absent) simply carry no
         # target arenas; requesting head_types at collate then raises.
@@ -279,6 +285,24 @@ class GraphArena:
             else:
                 raise ValueError(f"Unknown head type {htype}")
 
+        # Precomputed CSR boundaries — one O(E) host pass per batch replaces
+        # two searchsorted calls per op per conv layer in the compiled step.
+        row_ptr = build_row_ptr(receivers, n_pad)
+        graph_ptr = build_graph_ptr(node_graph, g_pad)
+        if not self._csr_validated or csr_debug_enabled():
+            # Structural O(E) checks only (deep=False): the pointers were
+            # bincount-built from these very ids two lines up, so for
+            # sorted in-range ids they provably equal the searchsorted
+            # boundaries — and serving builds one arena PER micro-batch
+            # flush, putting this on the collate hot path. The deep
+            # cross-check runs in the debug mode and the check_config gate.
+            deep = csr_debug_enabled()
+            validate_csr(receivers, row_ptr, n_pad, what="receivers", deep=deep)
+            validate_csr(
+                node_graph, graph_ptr, g_pad, what="node_graph", deep=deep
+            )
+            self._csr_validated = True
+
         return GraphBatch(
             node_features=node_features,
             edge_features=edge_features,
@@ -289,6 +313,8 @@ class GraphArena:
             edge_mask=edge_mask,
             graph_mask=graph_mask,
             targets=tuple(targets),
+            row_ptr=row_ptr,
+            graph_ptr=graph_ptr,
             num_graphs_pad=g_pad,
         )
 

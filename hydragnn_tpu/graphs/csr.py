@@ -1,0 +1,145 @@
+"""CSR batch contract: destination-sorted edges + precomputed row pointers.
+
+Collation already sorts every graph's edges by receiver (graphs/collate.py:
+GraphArena), which makes batch receivers globally non-decreasing — the layout
+the scatter-free sorted segment path (ops/segment_sorted.py) requires. Until
+PR 7 that layout was a CONVENTION: every conv layer re-derived its segment
+boundaries with two ``searchsorted`` calls per op per layer, and nothing
+checked the assumption.
+
+This module promotes the layout to a first-class contract:
+
+* :func:`build_row_ptr` — ``row_ptr[N_pad + 1]`` over the padded receiver
+  array (``row_ptr[n]`` = first edge whose receiver is ``>= n``;
+  ``row_ptr[n + 1] - row_ptr[n]`` = in-degree of node ``n``). Computed ONCE
+  per batch on the host (O(E) bincount + cumsum) and carried on
+  :class:`~hydragnn_tpu.graphs.batch.GraphBatch` so every conv layer of
+  every op consumes precomputed boundaries — zero in-step binary searches.
+* :func:`build_graph_ptr` — the same pointers over ``node_graph`` (nodes are
+  contiguous per graph by collation), consumed by the node→graph mean-pool
+  readout.
+* :func:`validate_csr` — the one checkable definition of the contract
+  (length, endpoints, monotonicity, agreement with the actual sorted ids),
+  run once per arena at first collation and by the ``check_config``
+  eval_shape gate; ``HYDRAGNN_DEBUG_LAYOUT=1`` re-validates every batch.
+
+Padding edges connect padding nodes at the TOP index (receiver
+``N_pad - 1``), so the padding node's row simply absorbs them — identical
+boundaries to what ``searchsorted`` derived in-step, which is why the
+precomputed path is bit-exact against the historical one (tests).
+
+Everything here is deterministic by contract (graftlint's
+collation-deterministic rule applies): pure numpy on (ids, shapes) only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def csr_debug_enabled() -> bool:
+    """Re-validate the CSR contract on EVERY collated batch (host-side) and
+    insert runtime layout assertions into the sorted-path ops
+    (ops/segment_sorted.attach_layout_check). Off by default: the contract
+    is validated once per arena; this flag is the loud diagnostic for
+    suspected layout regressions."""
+    return os.environ.get("HYDRAGNN_DEBUG_LAYOUT", "0") not in (
+        "0",
+        "false",
+        "False",
+    )
+
+
+def build_row_ptr(ids: np.ndarray, num_segments: int) -> np.ndarray:
+    """``row_ptr[num_segments + 1]`` int32 for NON-DECREASING ``ids`` [E].
+
+    ``row_ptr[s] = searchsorted(ids, s, side="left")`` computed in O(E) via
+    bincount + exclusive cumsum. The result is only meaningful under the
+    sorted contract — callers that cannot guarantee it must
+    :func:`validate_csr` (the arena does, once)."""
+    ids = np.asarray(ids)
+    counts = np.bincount(ids, minlength=num_segments)
+    if len(counts) > num_segments:
+        raise ValueError(
+            f"ids reference segment {int(ids.max())} >= num_segments "
+            f"{num_segments}"
+        )
+    row_ptr = np.zeros(num_segments + 1, dtype=np.int32)
+    np.cumsum(counts, out=row_ptr[1:])
+    return row_ptr
+
+
+def build_graph_ptr(node_graph: np.ndarray, num_graphs: int) -> np.ndarray:
+    """``graph_ptr[num_graphs + 1]`` over the (sorted) node→graph ids — the
+    readout pooling's CSR boundaries."""
+    return build_row_ptr(node_graph, num_graphs)
+
+
+def validate_csr(
+    ids: np.ndarray,
+    row_ptr: np.ndarray,
+    num_segments: int,
+    what: str = "receivers",
+    num_rows: Optional[int] = None,
+    deep: bool = True,
+) -> None:
+    """Raise ``ValueError`` unless ``(ids, row_ptr)`` satisfies the CSR batch
+    contract:
+
+    * ``row_ptr`` has ``num_segments + 1`` entries, starts at 0, ends at
+      ``len(ids)`` (every edge owned by exactly one segment), and is
+      monotonically non-decreasing;
+    * ``ids`` is globally non-decreasing and in ``[0, num_segments)``;
+    * (``deep`` only) the pointers agree with the ids: ``row_ptr[s]`` is
+      exactly the first position with ``ids >= s`` for every segment.
+
+    ``deep=False`` skips the O(N log E) searchsorted cross-check — for
+    sorted, in-range ids a bincount-built ``row_ptr`` (build_row_ptr) IS the
+    searchsorted boundary set, so callers validating pointers they just
+    built from the same ids (the collation hot path: serving builds one
+    arena per micro-batch flush) only need the O(E) structural checks. Keep
+    the default for pointers of unknown provenance (the check_config gate,
+    tests)."""
+    ids = np.asarray(ids)
+    row_ptr = np.asarray(row_ptr)
+    e = len(ids) if num_rows is None else int(num_rows)
+    if row_ptr.shape != (num_segments + 1,):
+        raise ValueError(
+            f"CSR contract violated for {what}: row_ptr shape "
+            f"{row_ptr.shape} != ({num_segments + 1},)"
+        )
+    if row_ptr[0] != 0 or row_ptr[-1] != e:
+        raise ValueError(
+            f"CSR contract violated for {what}: row_ptr endpoints "
+            f"({int(row_ptr[0])}, {int(row_ptr[-1])}) != (0, {e})"
+        )
+    if (np.diff(row_ptr) < 0).any():
+        raise ValueError(
+            f"CSR contract violated for {what}: row_ptr is not monotone"
+        )
+    if len(ids):
+        if (np.diff(ids) < 0).any():
+            k = int(np.argmax(np.diff(ids) < 0))
+            raise ValueError(
+                f"CSR contract violated for {what}: ids not sorted at row "
+                f"{k} ({int(ids[k])} -> {int(ids[k + 1])})"
+            )
+        if int(ids.min()) < 0 or int(ids.max()) >= num_segments:
+            raise ValueError(
+                f"CSR contract violated for {what}: ids outside "
+                f"[0, {num_segments})"
+            )
+    if not deep:
+        return
+    expect = np.searchsorted(ids, np.arange(num_segments + 1)).astype(
+        row_ptr.dtype
+    )
+    if not np.array_equal(row_ptr, expect):
+        bad = int(np.argmax(row_ptr != expect))
+        raise ValueError(
+            f"CSR contract violated for {what}: row_ptr[{bad}] = "
+            f"{int(row_ptr[bad])}, ids say {int(expect[bad])}"
+        )

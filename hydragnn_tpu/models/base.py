@@ -291,7 +291,9 @@ class HydraGNN(nn.Module):
         # Reference encoder loop: x = relu(bn(conv(x))) (Base.py:236-243).
         for conv, bn in zip(self.convs, self.batch_norms):
             # train passed positionally: nn.remat static_argnums needs it
-            # positional to keep the python-bool branch static.
+            # positional to keep the python-bool branch static. row_ptr (the
+            # CSR batch contract) rides behind it so every layer consumes
+            # collation's precomputed segment boundaries.
             c = conv(
                 x,
                 batch.senders,
@@ -300,13 +302,15 @@ class HydraGNN(nn.Module):
                 batch.edge_mask,
                 batch.node_mask,
                 train,
+                batch.row_ptr,
             )
             x = nn.relu(bn(c, batch.node_mask, train))
 
-        # Masked global mean pool (Base.py:247-250).
+        # Masked global mean pool (Base.py:247-250); graph_ptr is the CSR
+        # boundary array over node_graph (nodes are contiguous per graph).
         x_graph = pallas_segment.fused_segment_mean(
             x, batch.node_graph, batch.num_graphs_pad, mask=batch.node_mask,
-            sorted_ids=True
+            sorted_ids=True, row_ptr=batch.graph_ptr,
         )
 
         outputs = []
@@ -335,6 +339,7 @@ class HydraGNN(nn.Module):
                             batch.edge_mask,
                             batch.node_mask,
                             train,
+                            batch.row_ptr,
                         )
                         # Reference applies relu(bn(.)) through the output layer
                         # too (Base.forward, Base.py:261-265).

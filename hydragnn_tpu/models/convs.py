@@ -8,8 +8,13 @@ dynamic shapes: padding edges connect padding nodes, so aggregation needs no
 special-casing beyond the statistics masks.
 
 Call convention (all convs):
-    y = conv(x, senders, receivers, edge_attr, edge_mask, node_mask, train=...)
-with x: [N_pad, F], senders/receivers: [E_pad], edge_attr: [E_pad, D] or None.
+    y = conv(x, senders, receivers, edge_attr, edge_mask, node_mask, train=...,
+             row_ptr=None)
+with x: [N_pad, F], senders/receivers: [E_pad], edge_attr: [E_pad, D] or None,
+row_ptr: [N_pad + 1] CSR boundaries over the destination-sorted receivers (the
+PR-7 batch contract, graphs/csr.py) or None — when present, every sorted-path
+aggregation consumes precomputed boundaries (zero in-step searchsorted) and
+the Pallas opt-in routes to the CSR run-walk kernels.
 """
 
 from __future__ import annotations
@@ -22,6 +27,14 @@ import flax.linen as nn
 
 from ..ops import pallas_segment
 
+# Conv families whose aggregation rides the sorted/CSR edge layout end to end
+# (every family since PR 7 — GAT's sort-breaking [edges; self-loops] concat
+# was replaced by an explicit self-attention term). check_config consults
+# this registry: a future family missing here would silently fall back to
+# the unsorted scatter path on TPU, which the contract checker now rejects
+# instead (analysis/contracts.py).
+SORTED_PATH_FAMILIES = frozenset({"SAGE", "GIN", "MFC", "GAT", "CGCNN", "PNA"})
+
 
 class SAGEConv(nn.Module):
     """GraphSAGE (mean aggregation): W_self·x_i + W_nbr·mean_j x_j.
@@ -31,9 +44,9 @@ class SAGEConv(nn.Module):
     axis_name: Optional[str] = None  # mesh axis for edge-sharded graph parallelism
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
+    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False, row_ptr=None):
         n = x.shape[0]
-        nbr = pallas_segment.fused_segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name, sorted_ids=True)
+        nbr = pallas_segment.fused_segment_mean(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name, sorted_ids=True, row_ptr=row_ptr)
         return nn.Dense(self.out_dim, name="lin_nbr")(nbr) + nn.Dense(
             self.out_dim, name="lin_self"
         )(x)
@@ -48,10 +61,10 @@ class GINConv(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
+    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False, row_ptr=None):
         n = x.shape[0]
         eps = self.param("eps", nn.initializers.constant(self.eps_init), ())
-        agg = pallas_segment.fused_segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name, sorted_ids=True)
+        agg = pallas_segment.fused_segment_sum(x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name, sorted_ids=True, row_ptr=row_ptr)
         h = (1.0 + eps) * x + agg
         h = nn.Dense(self.out_dim, name="mlp_0")(h)
         h = nn.relu(h)
@@ -69,7 +82,7 @@ class MFCConv(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
+    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False, row_ptr=None):
         n, f = x.shape
         d = self.max_degree + 1
         w_self = self.param(
@@ -79,7 +92,7 @@ class MFCConv(nn.Module):
         b = self.param("bias", nn.initializers.zeros, (d, self.out_dim))
         agg, deg_f = pallas_segment.fused_segment_sum_count(
             x[senders], receivers, n, mask=edge_mask, axis_name=self.axis_name,
-            sorted_ids=True,
+            sorted_ids=True, row_ptr=row_ptr,
         )
         deg = jnp.clip(deg_f.astype(jnp.int32), 0, self.max_degree)
         out = jnp.einsum("nf,nfo->no", x, w_self[deg]) + jnp.einsum(
@@ -92,7 +105,16 @@ class GATv2Conv(nn.Module):
     """GATv2 multi-head attention over incoming edges, with implicit self-loops and
     masked segment softmax (reference GATStack.py:88-97; heads=6,
     negative_slope=0.05 hardcoded by create.py:112-114, attention dropout wired to
-    the model's dropout rate)."""
+    the model's dropout rate).
+
+    Self-loops are an EXPLICIT self-attention term, not the historical
+    ``[edges; self-loops]`` concat: for node ``i`` the softmax runs over
+    {incoming edges} ∪ {i itself}, with the self logit computed densely
+    [N, h] and its exp added to the segment denominator. Mathematically
+    identical to concatenating one identity edge per node (parity-locked in
+    tests/test_csr_contract.py), but the edge array keeps collation's
+    destination-sorted order — GAT rides the sorted/CSR aggregation path
+    like every other family instead of being the one scatter-bound holdout."""
 
     out_dim: int  # per-head output dim
     heads: int = 6
@@ -102,35 +124,73 @@ class GATv2Conv(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
+    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False, row_ptr=None):
+        from ..ops import segment as seg
+
         n = x.shape[0]
         h, f = self.heads, self.out_dim
         x_src = nn.Dense(h * f, name="lin_src")(x).reshape(n, h, f)
         x_dst = nn.Dense(h * f, name="lin_dst")(x).reshape(n, h, f)
 
-        # Self-loops: append one identity edge per node (static shape E_pad + N_pad).
-        # Under graph parallelism only shard 0 contributes the self-loops, or the
-        # psum'd aggregation would count them axis_size times.
-        self_mask = node_mask
-        if self.axis_name is not None:
-            self_mask = self_mask & (jax.lax.axis_index(self.axis_name) == 0)
-        s = jnp.concatenate([senders, jnp.arange(n, dtype=senders.dtype)])
-        r = jnp.concatenate([receivers, jnp.arange(n, dtype=receivers.dtype)])
-        m = jnp.concatenate([edge_mask, self_mask])
-
         att = self.param("att", nn.initializers.lecun_normal(), (h, f))
-        pre = nn.leaky_relu(x_src[s] + x_dst[r], self.negative_slope)  # [E', h, f]
-        logits = jnp.einsum("ehf,hf->eh", pre, att)
-        alpha = pallas_segment.fused_segment_softmax(
-            logits, r, n, mask=m, axis_name=self.axis_name
-        )  # [E', h]
+        pre = nn.leaky_relu(
+            x_src[senders] + x_dst[receivers], self.negative_slope
+        )  # [E, h, f]
+        logits = jnp.einsum("ehf,hf->eh", pre, att)  # [E, h]
+        # Self term: the diagonal of the attention matrix, computed densely
+        # (x_src[i] + x_dst[i] — no gather, no extra edges).
+        pre_self = nn.leaky_relu(x_src + x_dst, self.negative_slope)
+        logit_self = jnp.einsum("nhf,hf->nh", pre_self, att)  # [N, h]
+
+        # Stabilized softmax over edges ∪ self. The per-node shift is the
+        # TRUE max of the contributing logits (stop_gradient like
+        # seg.segment_softmax): edgeless segments fill with -1e9, not 0, so
+        # an isolated node's shift is exactly its self logit and
+        # alpha_self = 1 there for ANY magnitude (a 0 fill would underflow
+        # exp(logit_self) for strongly negative self logits and silently
+        # drop the self message the concat formulation kept). m stays
+        # finite everywhere — logit_self is dense — so padding rows cannot
+        # produce NaNs.
+        edge_max = seg.segment_max(
+            logits, receivers, n, mask=edge_mask, fill=-1e9,
+            axis_name=self.axis_name,
+        )  # [N, h]
+        m = jax.lax.stop_gradient(jnp.maximum(edge_max, logit_self))
+        exp_e = jnp.where(
+            edge_mask[:, None], jnp.exp(logits - m[receivers]), 0.0
+        )  # [E, h]
+        exp_self = jnp.where(
+            node_mask[:, None], jnp.exp(logit_self - m), 0.0
+        )  # [N, h]
+        # The edge half of the denominator is globally reduced under graph
+        # parallelism (psum inside fused_segment_sum); the self half is
+        # identical on every shard (nodes replicated) and added AFTER the
+        # reduction, so it is counted exactly once — the replacement for the
+        # old shard-0-only self-loop mask.
+        denom = pallas_segment.fused_segment_sum(
+            exp_e, receivers, n, mask=edge_mask, axis_name=self.axis_name,
+            sorted_ids=True, row_ptr=row_ptr,
+        ) + exp_self
+        alpha = exp_e / jnp.maximum(denom[receivers], 1e-16)  # [E, h]
+        alpha_self = exp_self / jnp.maximum(denom, 1e-16)  # [N, h]
         if train and self.dropout > 0.0:
             rng = self.make_rng("dropout")
-            keep = jax.random.bernoulli(rng, 1.0 - self.dropout, alpha.shape)
-            alpha = jnp.where(keep, alpha / (1.0 - self.dropout), 0.0)
-        msgs = x_src[s] * alpha[..., None]  # [E', h, f]
-        msgs = jnp.where(m[:, None, None], msgs, 0.0)
-        out = pallas_segment.fused_segment_sum(msgs, r, n, axis_name=self.axis_name)  # [N, h, f]
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.dropout, (n + alpha.shape[0],) + alpha.shape[1:]
+            )
+            alpha = jnp.where(
+                keep[n:], alpha / (1.0 - self.dropout), 0.0
+            )
+            alpha_self = jnp.where(
+                keep[:n], alpha_self / (1.0 - self.dropout), 0.0
+            )
+        msgs = x_src[senders] * alpha[..., None]  # [E, h, f]
+        msgs = jnp.where(edge_mask[:, None, None], msgs, 0.0)
+        out = pallas_segment.fused_segment_sum(
+            msgs, receivers, n, axis_name=self.axis_name, sorted_ids=True,
+            row_ptr=row_ptr,
+        )  # [N, h, f]
+        out = out + x_src * alpha_self[..., None]  # the self-loop message
         if self.concat:
             out = out.reshape(n, h * f)
             bias = self.param("bias", nn.initializers.zeros, (h * f,))
@@ -149,7 +209,7 @@ class CGConv(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
+    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False, row_ptr=None):
         n, f = x.shape
         z = [x[receivers], x[senders]]
         if self.edge_dim and edge_attr is not None:
@@ -160,7 +220,7 @@ class CGConv(nn.Module):
         msgs = gate * core
         # Padding edges carry nonzero softplus output — mask before aggregation.
         msgs = jnp.where(edge_mask[:, None], msgs, 0.0)
-        return x + pallas_segment.fused_segment_sum(msgs, receivers, n, axis_name=self.axis_name, sorted_ids=True)
+        return x + pallas_segment.fused_segment_sum(msgs, receivers, n, axis_name=self.axis_name, sorted_ids=True, row_ptr=row_ptr)
 
 
 class PNAConv(nn.Module):
@@ -182,7 +242,7 @@ class PNAConv(nn.Module):
     scalers: Tuple[str, ...] = ("identity", "amplification", "attenuation", "linear")
 
     @nn.compact
-    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False):
+    def __call__(self, x, senders, receivers, edge_attr, edge_mask, node_mask, train=False, row_ptr=None):
         n, f = x.shape
         z = [x[receivers], x[senders]]
         if self.edge_dim and edge_attr is not None:
@@ -195,6 +255,7 @@ class PNAConv(nn.Module):
         agg, deg = pallas_segment.pna_aggregate(
             msg, receivers, n, self.aggregators,
             mask=edge_mask, axis_name=self.axis_name, sorted_ids=True,
+            row_ptr=row_ptr,
         )  # agg: [N, A, f]
 
         deg = jnp.maximum(deg, 1.0)

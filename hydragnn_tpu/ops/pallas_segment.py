@@ -108,6 +108,21 @@ def pallas_enabled() -> bool:
     return False
 
 
+def csr_kernel_enabled() -> bool:
+    """Route Pallas traffic that carries precomputed CSR boundaries
+    (``row_ptr`` — the PR-7 batch contract, graphs/csr.py) through the
+    CSR-blocked kernel instead of the legacy one-hot scatter matmul. Rides
+    UNDER the HYDRAGNN_PALLAS opt-in (pallas_enabled): with the kernel arm
+    enabled, HYDRAGNN_PALLAS_CSR=0 forces the legacy one-hot kernel — the
+    A/B pin benchmarks/pallas_matrix.py and tune_kernel.py use to race the
+    two kernel generations on hardware. Default on: when a caller has CSR
+    boundaries the run-walk kernel does strictly less work (no id compares,
+    exact empty-block skip from the pointers)."""
+    return pallas_enabled() and os.environ.get(
+        "HYDRAGNN_PALLAS_CSR", "1"
+    ) not in ("0", "false", "False")
+
+
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -355,6 +370,185 @@ def _sum_count_pallas(
     return total, out_cnt[:num_segments, 0]
 
 
+# ----------------------------------------------------------- CSR-blocked kernel
+def _csr_kernel():
+    """CSR run-walk twin of the one-hot kernels (any operand count): the
+    one-hot factor is built from ROW POINTERS, not id comparisons —
+    ``onehot[n, e] = row_start[n] <= e_global < row_end[n]`` — so the kernel
+    never loads the edge-id array at all, and contiguous receiver runs give
+    an EXACT empty-block skip (the scalar-prefetched per-node-block edge
+    ranges come straight from ``row_ptr``, no id scan to derive them)."""
+    import jax.experimental.pallas as pl
+
+    def kern(lo_ref, hi_ref, rs_ref, re_ref, *args):
+        ops, sum_ref, cnt_ref = args[:-2], args[-2], args[-1]
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            sum_ref[:] = jnp.zeros_like(sum_ref)
+            cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+        @pl.when((j >= lo_ref[i]) & (j <= hi_ref[i]))
+        def _():
+            cols = jax.lax.broadcasted_iota(jnp.int32, (_BN, _BE), 1) + j * _BE
+            # rs/re blocks are (BN, 1): broadcast against the (BN, BE) iota.
+            onehot = ((cols >= rs_ref[:]) & (cols < re_ref[:])).astype(
+                jnp.float32
+            )
+            acc = jnp.dot(onehot, ops[0][:], preferred_element_type=jnp.float32)
+            for op in ops[1:]:
+                acc = acc + jnp.dot(
+                    onehot, op[:], preferred_element_type=jnp.float32
+                )
+            sum_ref[:] += acc
+            cnt_ref[:] += jnp.sum(onehot, axis=1, keepdims=True)
+
+    return kern
+
+
+def _csr_sum_count_pallas(
+    data: jnp.ndarray,
+    row_ptr: jnp.ndarray,
+    num_segments: int,
+    interpret: bool,
+    split: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused (sum, count) over contiguous receiver runs given by ``row_ptr``
+    [num_segments + 1] (the CSR batch contract). Masked rows must arrive
+    pre-zeroed with their edges owned by padding segments — exactly the
+    collation contract the sorted prefix path already relies on. Same
+    hi/lo bf16x2 accuracy split and f-packing as the one-hot kernel."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if _BE_ERROR is not None:
+        raise ValueError(_BE_ERROR)
+    e, f = data.shape
+    e_pad = _round_up(max(e, _BE), _BE)
+    n_pad = _round_up(max(num_segments, _BN), _BN)
+    rp = row_ptr.astype(jnp.int32)
+    # Rows beyond num_segments own no edges: empty runs [e, e).
+    row_start = jnp.full((n_pad, 1), e, jnp.int32).at[:num_segments, 0].set(
+        rp[:-1]
+    )
+    row_end = jnp.full((n_pad, 1), e, jnp.int32).at[:num_segments, 0].set(
+        rp[1:]
+    )
+
+    data32 = data.astype(jnp.float32)
+    packed = split and 2 * f <= 128
+    if packed:
+        f_pad = 128
+        hi = _round_bf16(data32)
+        lo = _round_bf16(data32 - hi)
+        data_p = (
+            jnp.zeros((e_pad, f_pad), jnp.float32)
+            .at[:e, :f].set(hi)
+            .at[:e, 64 : 64 + f].set(lo)
+        )
+        operands = (data_p,)
+    else:
+        f_pad = _round_up(max(f, 128), 128)
+        data_p = jnp.zeros((e_pad, f_pad), jnp.float32).at[:e, :f].set(data32)
+        if split:
+            hi = _round_bf16(data_p)
+            lo = _round_bf16(data_p - hi)
+            operands = (hi, lo)
+        else:
+            operands = (data_p,)
+
+    # Per-node-block edge-block ranges, straight from the pointers: block i's
+    # edges live in [row_ptr[i*BN], row_ptr[min((i+1)*BN, N)]) — contiguous
+    # by the CSR contract. hi_blk = -1 marks an empty block (predicate and
+    # DMA clamp both fail j <= hi).
+    n_blocks = n_pad // _BN
+    lo_edge = row_start.reshape(n_blocks, _BN).min(axis=1)
+    hi_edge = row_end.reshape(n_blocks, _BN).max(axis=1)  # exclusive
+    nonempty = hi_edge > lo_edge
+    lo_blk = jnp.where(nonempty, lo_edge // _BE, 0).astype(jnp.int32)
+    hi_blk = jnp.where(
+        nonempty, (jnp.maximum(hi_edge, 1) - 1) // _BE, -1
+    ).astype(jnp.int32)
+
+    def _edge_idx(i, j, lo_ref, hi_ref):
+        # Skipped pairs re-address block 0: an unchanged block index means
+        # the pipeline skips the DMA (same trick as the skip kernel).
+        return (jnp.where((j >= lo_ref[i]) & (j <= hi_ref[i]), j, 0), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_blocks, e_pad // _BE),
+        in_specs=[
+            pl.BlockSpec((_BN, 1), lambda i, j, lo_ref, hi_ref: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i, j, lo_ref, hi_ref: (i, 0)),
+        ]
+        + [pl.BlockSpec((_BE, f_pad), _edge_idx)] * len(operands),
+        out_specs=[
+            pl.BlockSpec((_BN, f_pad), lambda i, j, lo_ref, hi_ref: (i, 0)),
+            pl.BlockSpec((_BN, 1), lambda i, j, lo_ref, hi_ref: (i, 0)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+    ]
+    out_sum, out_cnt = pl.pallas_call(
+        _csr_kernel(),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(lo_blk, hi_blk, row_start, row_end, *operands)
+    total = out_sum[:num_segments, :f]
+    if packed:
+        total = total + out_sum[:num_segments, 64 : 64 + f]
+    return total, out_cnt[:num_segments, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _csr_sum_count_vjp(data, row_ptr, ids, num_segments, interpret, split, dtype_name):
+    return _csr_sum_count_pallas(data, row_ptr, num_segments, interpret, split)
+
+
+def _csr_sum_count_fwd(data, row_ptr, ids, num_segments, interpret, split, dtype_name):
+    out = _csr_sum_count_pallas(data, row_ptr, num_segments, interpret, split)
+    return out, (row_ptr, ids)
+
+
+def _csr_sum_count_bwd(num_segments, interpret, split, dtype_name, res, cots):
+    row_ptr, ids = res
+    d_sum, d_cnt = cots
+    del d_cnt  # count has no data dependence
+    # CSR contract: data arrives pre-zeroed at masked rows, ids RAW (masked
+    # rows target padding segments) — masking composes through the caller's
+    # jnp.where, so the backward is a plain gather like the sorted path's.
+    idx = jnp.clip(ids.astype(jnp.int32), 0, num_segments - 1)
+    d_data = jnp.take(d_sum, idx, axis=0)
+    return (
+        d_data.astype(dtype_name),
+        jnp.zeros(row_ptr.shape, jax.dtypes.float0),
+        jnp.zeros(ids.shape, jax.dtypes.float0),
+    )
+
+
+_csr_sum_count_vjp.defvjp(_csr_sum_count_fwd, _csr_sum_count_bwd)
+
+
+def csr_segment_sum_count(
+    data, row_ptr, ids, num_segments: int, interpret: bool = False,
+    split: bool = True,
+):
+    """Fused (sum, count) per segment over precomputed CSR boundaries — the
+    run-walk kernel behind every conv family's CSR-path aggregation
+    (sum/mean for SAGE/GIN/CGCNN, sum+count for MFC, both passes of the PNA
+    stats bundle). ``ids`` is only consumed by the gather backward; the
+    forward walks ``row_ptr`` alone."""
+    return _csr_sum_count_vjp(
+        data, row_ptr, ids, num_segments, interpret, split, str(data.dtype)
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def _sum_count_vjp(data, ids, num_segments, interpret, split, dtype_name):
     return _sum_count_pallas(data, ids, num_segments, interpret, split)
@@ -405,14 +599,18 @@ def segment_sum_count(
 
 def _stats_forward(
     data, ids, num_segments, eps, axis_name, interpret, want_std,
-    sorted_route=False,
+    sorted_route=False, row_ptr=None,
 ):
     if sorted_route:
         # Scatter-free path: data arrives pre-zeroed at masked rows and ids
         # RAW (sorted; masked rows target padding segments). The centered
         # second pass needs no mask handling — masked rows have data 0 and
         # a ~0 padding-segment mean, and padding outputs are never consumed.
-        total, count = srt.segment_sum_count_sorted(data, ids, num_segments)
+        # With CSR boundaries (row_ptr) the segment bounds are precomputed
+        # at collation — zero searchsorted calls in the traced step.
+        total, count = srt.segment_sum_count_auto(
+            data, ids, num_segments, row_ptr=row_ptr
+        )
         if axis_name is not None:
             total = jax.lax.psum(total, axis_name)
             count = jax.lax.psum(count, axis_name)
@@ -444,14 +642,27 @@ def _stats_forward(
         )
         return total, mean, std, count
     return _stats_forward_pallas(
-        data, ids, num_segments, eps, axis_name, interpret, want_std
+        data, ids, num_segments, eps, axis_name, interpret, want_std,
+        row_ptr=row_ptr,
     )
 
 
-def _stats_forward_pallas(data, ids, num_segments, eps, axis_name, interpret, want_std):
-    total, count = segment_sum_count(
-        data, ids, num_segments, interpret, _wants_split(data.dtype)
-    )
+def _stats_forward_pallas(data, ids, num_segments, eps, axis_name, interpret,
+                          want_std, row_ptr=None):
+    def _sum_count(d, i):
+        # CSR route (row_ptr present under the HYDRAGNN_PALLAS opt-in): the
+        # run-walk kernel — raw sorted ids, data pre-zeroed at masked rows
+        # (the caller enforced the CSR contract before dispatching here).
+        if row_ptr is not None:
+            return csr_segment_sum_count(
+                d, row_ptr, i, num_segments, interpret,
+                _wants_split(data.dtype),
+            )
+        return segment_sum_count(
+            d, i, num_segments, interpret, _wants_split(data.dtype)
+        )
+
+    total, count = _sum_count(data, ids)
     if axis_name is not None:
         total = jax.lax.psum(total, axis_name)
         count = jax.lax.psum(count, axis_name)
@@ -470,9 +681,14 @@ def _stats_forward_pallas(data, ids, num_segments, eps, axis_name, interpret, wa
     # std error is ~1.4e-5; at f <= 64 the packed layout makes it free.
     idx = jnp.clip(ids, 0, num_segments - 1)
     centered = jnp.where((ids >= 0)[:, None], data - mean[idx], 0.0)
-    sumsq, _ = segment_sum_count(
-        jnp.square(centered), ids, num_segments, interpret, True
-    )
+    if row_ptr is not None:
+        sumsq, _ = csr_segment_sum_count(
+            jnp.square(centered), row_ptr, ids, num_segments, interpret, True
+        )
+    else:
+        sumsq, _ = segment_sum_count(
+            jnp.square(centered), ids, num_segments, interpret, True
+        )
     if axis_name is not None:
         sumsq = jax.lax.psum(sumsq, axis_name)
     std = jnp.sqrt(sumsq / safe + eps)
@@ -481,21 +697,21 @@ def _stats_forward_pallas(data, ids, num_segments, eps, axis_name, interpret, wa
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def _stats(data, ids, num_segments, eps, axis_name, interpret, want_std,
-           sorted_route=False):
+           sorted_route=False, row_ptr=None):
     return _stats_forward(
         data, ids, num_segments, eps, axis_name, interpret, want_std,
-        sorted_route,
+        sorted_route, row_ptr,
     )
 
 
 def _stats_fwd(data, ids, num_segments, eps, axis_name, interpret, want_std,
-               sorted_route=False):
+               sorted_route=False, row_ptr=None):
     out = _stats_forward(
         data, ids, num_segments, eps, axis_name, interpret, want_std,
-        sorted_route,
+        sorted_route, row_ptr,
     )
     total, mean, std, count = out
-    return out, (data, ids, mean, std, count)
+    return out, (data, ids, mean, std, count, row_ptr)
 
 
 def _stats_bwd(num_segments, eps, axis_name, interpret, want_std, sorted_route,
@@ -508,7 +724,7 @@ def _stats_bwd(num_segments, eps, axis_name, interpret, want_std, sorted_route,
     — pure gathers, no scatter (scatter is the slow op on TPU). Under graph
     parallelism the incoming cotangents are per-device shares of the global
     outputs, so they are psum'd first (VJP of the forward psum)."""
-    data, ids, mean, std, count = res
+    data, ids, mean, std, count, row_ptr = res
     d_total, d_mean, d_std, d_count = cots
     del d_count  # no data dependence
     if axis_name is not None:
@@ -526,7 +742,15 @@ def _stats_bwd(num_segments, eps, axis_name, interpret, want_std, sorted_route,
         per_seg_quad = jnp.where(count[:, None] > 1.0, d_std / (std * safe), 0.0)
         d_data = d_data + per_seg_quad[idx] * (data - mean[idx])
     d_data = jnp.where(valid, d_data, 0.0)
-    return d_data.astype(data.dtype), jnp.zeros(ids.shape, jax.dtypes.float0)
+    d_row_ptr = (
+        None if row_ptr is None
+        else jnp.zeros(row_ptr.shape, jax.dtypes.float0)
+    )
+    return (
+        d_data.astype(data.dtype),
+        jnp.zeros(ids.shape, jax.dtypes.float0),
+        d_row_ptr,
+    )
 
 
 _stats.defvjp(_stats_fwd, _stats_bwd)
@@ -542,6 +766,7 @@ def fused_segment_stats(
     interpret: Optional[bool] = None,
     want_std: bool = True,
     sorted_ids: bool = False,
+    row_ptr: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(sum, mean, std, count) per segment from two fused passes — the PNA
     sum/mean/std aggregator family (drop-in for segment_sum + segment_mean +
@@ -549,25 +774,39 @@ def fused_segment_stats(
     ``want_std=False`` skips the centered second pass (std comes back as
     zeros) when only the sum/mean family is needed.
 
+    ``row_ptr`` (the CSR batch contract, graphs/csr.py) supplies precomputed
+    segment boundaries: the sorted prefix path then runs zero searchsorted
+    calls, and under HYDRAGNN_PALLAS the CSR run-walk kernel replaces the
+    one-hot scatter matmul for both fused passes.
+
     Under edge-sharded graph parallelism (``axis_name``) the raw partial sums
     are psum'd across the shard axis before the mean/std are formed — the same
     cross-device composition as the scatter path, but two collectives total.
+    Per-shard edge slices keep the sorted order but NOT the global ``row_ptr``
+    offsets, so the boundaries are re-derived locally in that mode.
     """
     ids = segment_ids.astype(jnp.int32)
     if interpret is None:
         interpret = _platform() != "tpu"
-    if sorted_ids and srt.sorted_enabled():
-        # Sorted contract: zero masked rows, keep RAW (sorted) ids — a -1
+    use_sorted, use_csr_kernel, row_ptr = _sorted_route(
+        sorted_ids, row_ptr, axis_name
+    )
+    if use_sorted or use_csr_kernel:
+        # Sorted/CSR contract: zero masked rows, keep RAW (sorted) ids — a -1
         # marker would break the non-decreasing order the path requires.
+        srt.attach_layout_check(ids)
         if mask is not None:
             data = jnp.where(mask[:, None], data, 0)
         return _stats(
             data.astype(jnp.float32), ids, num_segments, eps, axis_name,
-            interpret, want_std, True,
+            interpret, want_std, use_sorted, row_ptr,
         )
     if mask is not None:
         ids = jnp.where(mask, ids, -1)
-    return _stats(data, ids, num_segments, eps, axis_name, interpret, want_std)
+    return _stats(
+        data, ids, num_segments, eps, axis_name, interpret, want_std, False,
+        None,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -613,6 +852,7 @@ def certify_pallas(
     seed: int = 0,
     contiguous: bool = False,
     sorted_arm: bool = True,
+    csr_arm: bool = True,
 ) -> dict:
     """On-device certification of the fused kernel against the XLA segment
     ops: forward + gradient parity on the PNA aggregation workload (reference
@@ -760,55 +1000,76 @@ def certify_pallas(
         pallas_ms = best_ms(f_fused)
         xla_ms = best_ms(f_xla)
 
-        # Third arm on contiguous ids: the scatter-free sorted path
-        # (ops/segment_sorted.py). Measured UNMASKED — certify's random mask
-        # violates the sorted contract (masked rows must target padding
-        # segments), so its accuracy is checked against its own f64 truth.
-        # Forward AND gradient, like the other two arms.
+        # Further arms on contiguous ids: the scatter-free sorted path
+        # (ops/segment_sorted.py) and the CSR run-walk kernel
+        # (csr_segment_sum_count — the row_ptr batch contract). Measured
+        # UNMASKED — certify's random mask violates the sorted contract
+        # (masked rows must target padding segments), so their accuracy is
+        # checked against their own f64 truth. Forward AND gradient, like
+        # the other arms.
         sorted_res = None
-        if contiguous and sorted_arm:
-            _saved_srt = os.environ.get("HYDRAGNN_SEGMENT_SORTED")
-            os.environ["HYDRAGNN_SEGMENT_SORTED"] = "1"
-            try:
-                f_srt = jax.jit(
-                    lambda d: fused_segment_stats(d, ids, n, sorted_ids=True)
-                )
+        if contiguous and (sorted_arm or csr_arm):
+            d64 = np.asarray(data, np.float64)
+            ids_h = np.asarray(ids)
+            tot64 = np.zeros((n, f))
+            np.add.at(tot64, ids_h, d64)
+            cnt64 = np.bincount(ids_h, minlength=n).astype(np.float64)
+            safe64 = np.maximum(cnt64, 1.0)[:, None]
+            mean64 = tot64 / safe64
+            sq64 = np.zeros((n, f))
+            np.add.at(sq64, ids_h, np.square(d64 - mean64[ids_h]))
+            std64 = np.sqrt(sq64 / safe64 + 1e-5)
+            truths = (tot64, mean64, std64, cnt64)
+            # Same cotangent as the other arms' scalarize; dstd at
+            # single-count segments is identically 0 (std pinned there).
+            per_lin = 0.3 + 1.7 / safe64
+            quad = np.where(
+                cnt64[:, None] > 1.0, -0.9 / (std64 * safe64), 0.0
+            )
+            g64 = per_lin[ids_h] + quad[ids_h] * (d64 - mean64[ids_h])
+            row_ptr = jnp.asarray(
+                np.searchsorted(ids_h, np.arange(n + 1)).astype(np.int32)
+            )
 
-                def _srt_scalar(d):
-                    total, mean, std, _ = fused_segment_stats(
-                        d, ids, n, sorted_ids=True
+            def _measure_arm(tag, env, row_ptr_arg):
+                saved = {k: os.environ.get(k) for k in env}
+                os.environ.update(env)
+                try:
+                    def bundle(d):
+                        return fused_segment_stats(
+                            d, ids, n, sorted_ids=True, row_ptr=row_ptr_arg
+                        )
+
+                    f_arm = jax.jit(bundle)
+
+                    def _scalar(d):
+                        total, mean, std, _ = bundle(d)
+                        return jnp.sum(total * 0.3 + mean * 1.7 - std * 0.9)
+
+                    g_arm = jax.jit(jax.grad(_scalar))
+                    outs = jax.block_until_ready(f_arm(data))
+                    grad = jax.block_until_ready(g_arm(data))
+                    err = max(
+                        float(np.max(np.abs(np.asarray(o, np.float64) - t)))
+                        for o, t in zip(outs, truths)
                     )
-                    return jnp.sum(total * 0.3 + mean * 1.7 - std * 0.9)
+                    err_grad = float(
+                        np.max(np.abs(np.asarray(grad, np.float64) - g64))
+                    )
+                    arm_ms = best_ms(f_arm)
+                    return err, err_grad, arm_ms
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
 
-                g_srt = jax.jit(jax.grad(_srt_scalar))
-                outs = jax.block_until_ready(f_srt(data))
-                grad = jax.block_until_ready(g_srt(data))
-                d64 = np.asarray(data, np.float64)
-                ids_h = np.asarray(ids)
-                tot64 = np.zeros((n, f))
-                np.add.at(tot64, ids_h, d64)
-                cnt64 = np.bincount(ids_h, minlength=n).astype(np.float64)
-                safe64 = np.maximum(cnt64, 1.0)[:, None]
-                mean64 = tot64 / safe64
-                sq64 = np.zeros((n, f))
-                np.add.at(sq64, ids_h, np.square(d64 - mean64[ids_h]))
-                std64 = np.sqrt(sq64 / safe64 + 1e-5)
-                truths = (tot64, mean64, std64, cnt64)
-                err = max(
-                    float(np.max(np.abs(np.asarray(o, np.float64) - t)))
-                    for o, t in zip(outs, truths)
+            sorted_res = {}
+            if sorted_arm:
+                err, err_grad, sorted_ms = _measure_arm(
+                    "sorted", {"HYDRAGNN_SEGMENT_SORTED": "1"}, None
                 )
-                # Same cotangent as the other arms' scalarize; dstd at
-                # single-count segments is identically 0 (std pinned there).
-                per_lin = 0.3 + 1.7 / safe64
-                quad = np.where(
-                    cnt64[:, None] > 1.0, -0.9 / (std64 * safe64), 0.0
-                )
-                g64 = per_lin[ids_h] + quad[ids_h] * (d64 - mean64[ids_h])
-                err_grad = float(
-                    np.max(np.abs(np.asarray(grad, np.float64) - g64))
-                )
-                sorted_ms = best_ms(f_srt)
                 # Gradient gate: no regression vs the INCUMBENT default (the
                 # XLA bundle) rather than the kernel-grade 5e-4 — the sorted
                 # std grad inherits ~1/std^2 amplification at near-degenerate
@@ -816,19 +1077,40 @@ def certify_pallas(
                 # the XLA path production trains on today carries ~9e-2 from
                 # its E[x^2]-E[x]^2 cancellation. Promotion must not lose
                 # accuracy; it need not beat the Pallas kernel's.
-                sorted_res = {
-                    "sorted_ms": round(sorted_ms, 4),
-                    "sorted_err_fwd": err,
-                    "sorted_err_grad": err_grad,
-                    "sorted_ok": err < 5e-4
+                sorted_res.update(
+                    sorted_ms=round(sorted_ms, 4),
+                    sorted_err_fwd=err,
+                    sorted_err_grad=err_grad,
+                    sorted_ok=err < 5e-4
                     and err_grad <= max(5e-4, xla_err_grad),
-                    "sorted_speedup_vs_xla": round(sorted_ms and xla_ms / sorted_ms, 3),
-                }
-            finally:
-                if _saved_srt is None:
-                    os.environ.pop("HYDRAGNN_SEGMENT_SORTED", None)
-                else:
-                    os.environ["HYDRAGNN_SEGMENT_SORTED"] = _saved_srt
+                    sorted_speedup_vs_xla=round(
+                        sorted_ms and xla_ms / sorted_ms, 3
+                    ),
+                )
+            if csr_arm:
+                # CSR kernel arm: HYDRAGNN_PALLAS is already forced on for
+                # the whole certification; pin the sorted prefix path OFF so
+                # the row_ptr route resolves to the run-walk kernel, not the
+                # prefix-sum arm (the TPU default).
+                err, err_grad, csr_ms = _measure_arm(
+                    "csr",
+                    {
+                        "HYDRAGNN_SEGMENT_SORTED": "0",
+                        "HYDRAGNN_PALLAS_CSR": "1",
+                    },
+                    row_ptr,
+                )
+                # Same gates as the one-hot kernel (the tol/tol_grad pins
+                # below): the CSR kernel shares its bf16x2 split and
+                # analytic backward, so kernel-grade 5e-4 fwd / 5e-3 grad
+                # apply unchanged.
+                sorted_res.update(
+                    csr_ms=round(csr_ms, 4),
+                    csr_err_fwd=err,
+                    csr_err_grad=err_grad,
+                    csr_ok=err < 5e-4 and err_grad < 5e-3,
+                    csr_speedup_vs_xla=round(csr_ms and xla_ms / csr_ms, 3),
+                )
     finally:
         if _saved_env is None:
             os.environ.pop("HYDRAGNN_PALLAS", None)
@@ -885,26 +1167,52 @@ def _flatten_trailing(data):
     )
 
 
+def _sorted_route(sorted_ids: bool, row_ptr, axis_name):
+    """ONE resolution of the sorted/CSR dispatch every fused wrapper uses.
+
+    Returns ``(use_sorted, use_csr_kernel, row_ptr)``: the sorted prefix
+    path when enabled (precedence unchanged from r05), else the CSR
+    run-walk kernel when the caller supplied boundaries under the
+    HYDRAGNN_PALLAS opt-in. ``row_ptr`` comes back nulled under an
+    ``axis_name`` — global edge offsets are wrong for a local edge shard, so
+    sharded traffic re-derives boundaries locally. Centralized so a routing
+    change cannot silently diverge between wrappers (a missed site would
+    send that wrapper's traffic back to the scatter path — the 0.47x
+    regression class the contract checker guards against)."""
+    if axis_name is not None:
+        row_ptr = None
+    use_sorted = sorted_ids and srt.sorted_enabled()
+    use_csr_kernel = (
+        not use_sorted
+        and sorted_ids
+        and row_ptr is not None
+        and csr_kernel_enabled()
+    )
+    return use_sorted, use_csr_kernel, row_ptr
+
+
 def fused_segment_sum(
     data, segment_ids, num_segments: int, mask=None, axis_name=None,
-    sorted_ids: bool = False,
+    sorted_ids: bool = False, row_ptr=None,
 ):
     """Drop-in masked ``segment_sum`` used by every conv family's aggregation:
     the scatter-free sorted path when the caller guarantees non-decreasing
-    ids AND HYDRAGNN_SEGMENT_SORTED=1, the one-hot MXU kernel when opted in
-    (HYDRAGNN_PALLAS=1 — see pallas_enabled for why the default is the XLA
-    path since r05), the masked XLA segment op otherwise. Accepts any
-    [E, ...] float data (trailing dims flattened for the kernel)."""
+    ids AND HYDRAGNN_SEGMENT_SORTED=1 (with ``row_ptr`` — the CSR batch
+    contract — consuming precomputed boundaries instead of searching), the
+    CSR run-walk or one-hot MXU kernel when opted in (HYDRAGNN_PALLAS=1 —
+    see pallas_enabled for why the default is the XLA path since r05), the
+    masked XLA segment op otherwise. Accepts any [E, ...] float data
+    (trailing dims flattened for the kernel)."""
     total, _ = fused_segment_sum_count(
         data, segment_ids, num_segments, mask=mask, axis_name=axis_name,
-        sorted_ids=sorted_ids,
+        sorted_ids=sorted_ids, row_ptr=row_ptr,
     )
     return total
 
 
 def fused_segment_sum_count(
     data, segment_ids, num_segments: int, mask=None, axis_name=None,
-    sorted_ids: bool = False,
+    sorted_ids: bool = False, row_ptr=None,
 ):
     """Masked (segment_sum, segment_count) in ONE fused pass — callers that
     need both (MFC's degree lookup) save a whole scatter. Falls back to the
@@ -913,17 +1221,33 @@ def fused_segment_sum_count(
     ``sorted_ids=True`` declares the collation contract: non-decreasing ids
     with masked rows targeting padding segments (whose outputs are unused) —
     the sorted path's count includes masked rows, which is only correct
-    under that contract."""
-    if sorted_ids and srt.sorted_enabled():
+    under that contract. ``row_ptr`` carries the contract's precomputed CSR
+    boundaries (ignored under ``axis_name``: local edge shards keep sorted
+    order but not the global offsets)."""
+    use_sorted, use_csr_kernel, row_ptr = _sorted_route(
+        sorted_ids, row_ptr, axis_name
+    )
+    if use_sorted or use_csr_kernel:
+        # Sorted/CSR contract prep: zero masked rows, RAW (sorted) ids.
+        srt.attach_layout_check(segment_ids)
         flat, unflatten = _flatten_trailing(data)
         if mask is not None:
             flat = jnp.where(mask[:, None], flat, 0)
-        total, count = srt.segment_sum_count_sorted(
-            flat.astype(jnp.float32), segment_ids.astype(jnp.int32), num_segments
-        )
-        if axis_name is not None:
-            total = jax.lax.psum(total, axis_name)
-            count = jax.lax.psum(count, axis_name)
+        if use_sorted:
+            total, count = srt.segment_sum_count_auto(
+                flat.astype(jnp.float32), segment_ids.astype(jnp.int32),
+                num_segments, row_ptr=row_ptr,
+            )
+            if axis_name is not None:
+                total = jax.lax.psum(total, axis_name)
+                count = jax.lax.psum(count, axis_name)
+        else:
+            # CSR run-walk kernel (HYDRAGNN_PALLAS opt-in, row_ptr present).
+            total, count = csr_segment_sum_count(
+                flat.astype(jnp.float32), row_ptr,
+                segment_ids.astype(jnp.int32), num_segments,
+                _platform() != "tpu", _wants_split(flat.dtype),
+            )
         return unflatten(total.astype(data.dtype)), count
     if not pallas_enabled():
         return (
@@ -949,15 +1273,18 @@ def fused_segment_sum_count(
 
 def fused_segment_mean(
     data, segment_ids, num_segments: int, mask=None, axis_name=None,
-    sorted_ids: bool = False,
+    sorted_ids: bool = False, row_ptr=None,
 ):
     """Drop-in masked ``segment_mean`` over the fused kernel (SAGE neighbor
     mean, the global mean-pool readout). Both paths return ``data.dtype`` so
     CPU-fallback and TPU runs agree on dtype flow."""
-    if sorted_ids and srt.sorted_enabled():
+    use_sorted, use_csr_kernel, row_ptr = _sorted_route(
+        sorted_ids, row_ptr, axis_name
+    )
+    if use_sorted or use_csr_kernel:
         total, count = fused_segment_sum_count(
             data, segment_ids, num_segments, mask=mask, axis_name=axis_name,
-            sorted_ids=True,
+            sorted_ids=True, row_ptr=row_ptr,
         )
         safe = jnp.maximum(count, 1.0).reshape(
             count.shape + (1,) * (total.ndim - count.ndim)
@@ -977,17 +1304,36 @@ def fused_segment_mean(
 
 
 def fused_segment_softmax(
-    logits, segment_ids, num_segments: int, mask=None, axis_name=None
+    logits, segment_ids, num_segments: int, mask=None, axis_name=None,
+    sorted_ids: bool = False, row_ptr=None,
 ):
-    """Segment softmax (GATv2 attention over incoming edges) with the
-    denominator's scatter on the fused MXU kernel — one shared stabilization
-    body (seg.segment_softmax) with the sum injected, so the TPU and fallback
-    paths cannot drift. The per-segment max stays on XLA ``segment_max``
-    (extrema can't ride the MXU) under stop_gradient, so no scatter appears
-    in the backward either."""
+    """Generic segment softmax with the denominator's scatter on the fused
+    MXU kernel or the scatter-free sorted/CSR path — one shared
+    stabilization body (seg.segment_softmax) with the sum injected, so the
+    TPU and fallback paths cannot drift. The per-segment max stays on XLA
+    ``segment_max`` (extrema can't ride the MXU) under stop_gradient, so no
+    scatter appears in the backward either.
+
+    NOTE: GATv2Conv no longer routes through here — its softmax runs over
+    {incoming edges} ∪ {self} and is built inline from seg.segment_max +
+    fused_segment_sum so the dense self term can join the denominator
+    (models/convs.py:GATv2Conv). This stays the entry point for plain
+    edge-only segment softmaxes; ``sorted_ids``/``row_ptr`` declare the CSR
+    batch contract for the denominator sum."""
+    use_sorted, use_csr_kernel, _ = _sorted_route(
+        sorted_ids, row_ptr, axis_name
+    )
+    use_fast = pallas_enabled() or use_sorted or use_csr_kernel
+    sum_fn = None
+    if use_fast:
+        def sum_fn(d, i, n, mask=None, axis_name=None):
+            return fused_segment_sum(
+                d, i, n, mask=mask, axis_name=axis_name,
+                sorted_ids=sorted_ids, row_ptr=row_ptr,
+            )
     return seg.segment_softmax(
         logits, segment_ids, num_segments, mask=mask, axis_name=axis_name,
-        sum_fn=fused_segment_sum if pallas_enabled() else None,
+        sum_fn=sum_fn,
     )
 
 
@@ -999,12 +1345,14 @@ def pna_aggregate(
     mask: Optional[jnp.ndarray] = None,
     axis_name: Optional[str] = None,
     sorted_ids: bool = False,
+    row_ptr=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """PNA multi-aggregator bundle → (stacked [N, A, F] aggregates, count [N]).
 
-    Routes the sum/mean/std family through the scatter-free sorted path or
-    the fused Pallas kernel when enabled; min/max always via XLA segment
-    extrema. Falls back entirely to the masked XLA segment ops otherwise.
+    Routes the sum/mean/std family through the scatter-free sorted path
+    (precomputed CSR boundaries when ``row_ptr`` is present) or the fused
+    Pallas kernel when enabled; min/max always via XLA segment extrema.
+    Falls back entirely to the masked XLA segment ops otherwise.
     """
     n = num_segments
     use_sorted = sorted_ids and srt.sorted_enabled()
@@ -1015,6 +1363,7 @@ def pna_aggregate(
             total, mean, std, count = fused_segment_stats(
                 msg, receivers, n, mask=mask, axis_name=axis_name,
                 want_std="std" in aggregators, sorted_ids=sorted_ids,
+                row_ptr=row_ptr,
             )
             fused = {"mean": mean, "std": std, "sum": total}
         if "min" in aggregators or "max" in aggregators:

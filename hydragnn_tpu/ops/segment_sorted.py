@@ -8,12 +8,19 @@ segment_sum — TPU's worst op as a scatter — into pure prefix sums and
 gathers:
 
     P[k]   = sum(data[:k])                       (compensated prefix, below)
-    out[s] = P[right_s] - P[left_s],   left/right = searchsorted(ids, s)
+    out[s] = P[right_s] - P[left_s]
     cnt[s] = right_s - left_s                    (EXACT, integer)
 
+where left/right come from the batch's precomputed CSR ``row_ptr``
+(graphs/csr.py — collation builds and validates it once per batch) or, when
+no boundaries were provided, from two in-step ``searchsorted`` calls (the
+pre-PR-7 derivation, kept for callers outside the batch contract and for
+edge-sharded graph parallelism where global offsets don't apply).
+
 Cost: one O(E·F) chunked cumsum (HBM-bound, log-depth on TPU), a short
-TwoSum carry scan over chunk totals, two binary searches [N], two gathers
-[N, F]. Zero MXU work, zero scatter, no O(N·E) one-hot.
+TwoSum carry scan over chunk totals, two gathers [N, F] — and zero binary
+searches when ``row_ptr`` rides along. Zero MXU work, zero scatter, no
+O(N·E) one-hot.
 
 Accuracy: a raw f32 prefix difference cancels against the magnitude of the
 WHOLE prefix (worst ~1e-3 at E=16k), so the prefix is two-level: f32 cumsum
@@ -28,8 +35,10 @@ OPT-IN (HYDRAGNN_SEGMENT_SORTED=1) until measured on TPU hardware — the
 sorted arm rides along automatically whenever ``certify_pallas`` runs on
 contiguous ids (bench.py each round; benchmarks/tune_kernel.py's first sweep
 arm; benchmarks/hw_watchdog.sh's bench_sorted step measures it in the real
-train step). Convs request it via ``sorted_ids=True`` on the fused_*
-wrappers (GAT's self-loop concat breaks sortedness and never does).
+train step). Convs request it via ``sorted_ids=True`` (+ the batch's
+``row_ptr``) on the fused_* wrappers — since PR 7 that includes GAT, whose
+self-loops became an explicit self-attention term instead of the
+sort-breaking ``[edges; self-loops]`` concat (models/convs.py:GATv2Conv).
 """
 
 from __future__ import annotations
@@ -40,6 +49,47 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# Trace-time spy: number of searchsorted boundary derivations traced by this
+# module. The CSR batch contract (graphs/csr.py) exists to drive this to ZERO
+# in the compiled step — collation precomputes ``row_ptr`` once per batch and
+# every sorted-path op consumes it. tests/test_csr_contract.py asserts a full
+# model trace with row_ptr present increments this by 0.
+SEARCHSORTED_CALLS = 0
+
+
+def searchsorted_calls() -> int:
+    return SEARCHSORTED_CALLS
+
+
+def _host_assert_sorted(ids, what="segment ids"):
+    """jax.debug.callback target: loud failure on a layout regression."""
+    import numpy as np
+
+    arr = np.asarray(ids)
+    if len(arr) and (np.diff(arr) < 0).any():
+        k = int(np.argmax(np.diff(arr) < 0))
+        raise RuntimeError(
+            f"sorted-layout contract violated: {what} decrease at row {k} "
+            f"({int(arr[k])} -> {int(arr[k + 1])}) — a caller passed "
+            "sorted_ids=True on an unsorted layout (HYDRAGNN_DEBUG_LAYOUT "
+            "check)"
+        )
+
+
+def attach_layout_check(ids: jnp.ndarray, what: str = "segment ids") -> None:
+    """Debug-mode runtime assertion that ``ids`` really is non-decreasing.
+
+    The ``fused_*`` wrappers accept ``sorted_ids=True`` on the caller's word;
+    collation validates its own batches once per arena (graphs/csr.py), but a
+    NEW caller with a broken layout would silently corrupt aggregation. Under
+    ``HYDRAGNN_DEBUG_LAYOUT=1`` (read at trace time, like every other gate
+    here) each sorted-path op embeds a host callback that raises on the first
+    unsorted batch; default off — zero cost in production steps."""
+    from ..graphs.csr import csr_debug_enabled
+
+    if csr_debug_enabled():
+        jax.debug.callback(functools.partial(_host_assert_sorted, what=what), ids)
 
 
 def sorted_enabled() -> bool:
@@ -104,8 +154,7 @@ def _prefix_open(data32: jnp.ndarray):
     return local.reshape(e_pad, f), hi, err, chunk
 
 
-def _sum_count_sorted(data, ids, num_segments: int):
-    ids = ids.astype(jnp.int32)
+def _sum_count_sorted(data, ids, num_segments: int, row_ptr=None):
     data32 = data.astype(jnp.float32)
     if data32.shape[0] == 0:
         # Drop-in parity with segment_sum on an empty edge set: exact zeros
@@ -121,9 +170,20 @@ def _sum_count_sorted(data, ids, num_segments: int):
     # the difference (masked rows contribute -mu then get +mu back: net 0).
     mu = jnp.mean(data32, axis=0)
     local, hi, err, chunk = _prefix_open(data32 - mu)
-    seg = jnp.arange(num_segments, dtype=jnp.int32)
-    left = jnp.searchsorted(ids, seg, side="left").astype(jnp.int32)
-    right = jnp.searchsorted(ids, seg, side="right").astype(jnp.int32)
+    if row_ptr is not None:
+        # CSR batch contract: collation precomputed the boundaries once per
+        # batch (graphs/csr.py). Identical values to the searchsorted
+        # derivation below (validated at collation), so the two paths are
+        # bit-exact — tests/test_csr_contract.py pins that.
+        row_ptr = row_ptr.astype(jnp.int32)
+        left, right = row_ptr[:-1], row_ptr[1:]
+    else:
+        ids = ids.astype(jnp.int32)
+        seg = jnp.arange(num_segments, dtype=jnp.int32)
+        global SEARCHSORTED_CALLS
+        SEARCHSORTED_CALLS += 1
+        left = jnp.searchsorted(ids, seg, side="left").astype(jnp.int32)
+        right = jnp.searchsorted(ids, seg, side="right").astype(jnp.int32)
 
     def parts(k):
         """(hi, err, local) components of P[k] = sum(data[:k]); k in [0, E]."""
@@ -174,15 +234,54 @@ def _bwd(num_segments, res, cots):
 segment_sum_count_sorted.defvjp(_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def segment_sum_count_csr(data, row_ptr, ids, num_segments: int):
+    """(segment_sum, segment_count) from PRECOMPUTED CSR boundaries — the
+    zero-searchsorted twin of :func:`segment_sum_count_sorted`. ``row_ptr``
+    [num_segments + 1] comes from collation (graphs/csr.py); ``ids`` is kept
+    only for the gather backward (it never enters the forward)."""
+    return _sum_count_sorted(data, ids, num_segments, row_ptr=row_ptr)
+
+
+def _csr_fwd(data, row_ptr, ids, num_segments):
+    carrier = jnp.zeros((0,), data.dtype)
+    out = _sum_count_sorted(data, ids, num_segments, row_ptr=row_ptr)
+    return out, (row_ptr, ids, carrier)
+
+
+def _csr_bwd(num_segments, res, cots):
+    row_ptr, ids, carrier = res
+    d_total, _ = cots
+    idx = jnp.clip(ids.astype(jnp.int32), 0, num_segments - 1)
+    d_data = jnp.take(d_total, idx, axis=0).astype(carrier.dtype)
+    return (
+        d_data,
+        jnp.zeros(row_ptr.shape, jax.dtypes.float0),
+        jnp.zeros(ids.shape, jax.dtypes.float0),
+    )
+
+
+segment_sum_count_csr.defvjp(_csr_fwd, _csr_bwd)
+
+
+def segment_sum_count_auto(data, ids, num_segments: int, row_ptr=None):
+    """Dispatch between the precomputed-boundary and searchsorted variants —
+    the single entry the fused wrappers route sorted traffic through."""
+    if row_ptr is not None:
+        return segment_sum_count_csr(data, row_ptr, ids, num_segments)
+    return segment_sum_count_sorted(data, ids, num_segments)
+
+
 def segment_sum_sorted(
-    data, ids, num_segments: int, mask: Optional[jnp.ndarray] = None
+    data, ids, num_segments: int, mask: Optional[jnp.ndarray] = None,
+    row_ptr=None,
 ):
     """Masked drop-in segment_sum for sorted ids ([E, ...] data)."""
     shape = data.shape
     flat = data.reshape(shape[0], -1) if data.ndim != 2 else data
     if mask is not None:
         flat = jnp.where(mask[:, None], flat, 0)
-    total, _ = segment_sum_count_sorted(flat, ids, num_segments)
+    total, _ = segment_sum_count_auto(flat, ids, num_segments, row_ptr=row_ptr)
     out = total.astype(data.dtype)
     if data.ndim != 2:
         out = out.reshape((num_segments,) + shape[1:])

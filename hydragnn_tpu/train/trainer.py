@@ -285,6 +285,11 @@ def _batch_pspec(batch: GraphBatch, graph_sharded: bool) -> GraphBatch:
         edge_mask=edge_spec,
         graph_mask=P("data"),
         targets=tuple(P("data") for _ in batch.targets),
+        # CSR boundaries are node-/graph-indexed (never edge-sharded; the ops
+        # layer ignores row_ptr under an axis_name — global edge offsets are
+        # wrong for a local shard).
+        row_ptr=None if batch.row_ptr is None else P("data"),
+        graph_ptr=None if batch.graph_ptr is None else P("data"),
         num_graphs_pad=batch.num_graphs_pad,
     )
 

@@ -116,3 +116,43 @@ def pytest_last_known_serving_none_when_no_measurements(tmp_path):
 
     (tmp_path / "SERVE_bad.json").write_text("{not json")
     assert _last_known_serving(str(tmp_path)) is None
+
+
+def pytest_last_known_kernels_picks_latest_real_round(tmp_path):
+    from bench import _last_known_kernels
+
+    real = {
+        "metric": "kernel_fight",
+        "value": 1.2,
+        "backend": "tpu",
+        "arms": {
+            "xla": {"ms": 0.08, "ok": True, "speedup_vs_xla": 1.0},
+            "pallas_csr": {"ms": 0.066, "ok": True, "speedup_vs_xla": 1.2},
+        },
+    }
+    (tmp_path / "KERNELS_r07.json").write_text(json.dumps(real))
+    # A failed --kernels round carries no arms — never "last known".
+    (tmp_path / "KERNELS_r08.json").write_text(
+        json.dumps({"metric": "kernel_fight", "error": "TimeoutError"})
+    )
+    now = time.time()
+    os.utime(tmp_path / "KERNELS_r07.json", (now - 50, now - 50))
+    os.utime(tmp_path / "KERNELS_r08.json", (now - 10, now - 10))
+
+    blk = _last_known_kernels(str(tmp_path))
+    assert blk is not None
+    assert blk["value"] == 1.2
+    assert blk["arms"]["pallas_csr"]["speedup_vs_xla"] == 1.2
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "KERNELS_r07.json"
+
+
+def pytest_committed_kernels_artifact_readable():
+    """The committed KERNELS_r* round is a valid last-known block (the
+    stale-fallback convention every bench arm follows)."""
+    from bench import _last_known_kernels
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_kernels(repo)
+    assert blk is not None
+    assert set(blk["arms"]) >= {"xla", "pallas_onehot", "pallas_csr", "sorted"}
