@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -810,10 +811,12 @@ def faults_main() -> int:
 
 def analyze_main() -> int:
     """``python bench.py --analyze``: the round's static-health line
-    (ANALYSIS_rNN.json) — graftlint rule hit counts + suppression count over
-    the package, and check-config wall time over the committed CI configs —
-    so the trajectory artifacts track static health alongside perf. CPU-safe
-    and hardware-free by construction."""
+    (ANALYSIS_rNN.json) — graftlint + graftrace rule hit counts +
+    suppression count over the package, the thread-root/lock-graph summary,
+    the seeded tsan drill outcome over the serve + async-checkpoint paths,
+    and check-config wall time over the committed CI configs — so the
+    trajectory artifacts track static health alongside perf. CPU-safe and
+    hardware-free by construction."""
     result = {
         "metric": "static_analysis",
         "value": 0.0,
@@ -826,21 +829,82 @@ def analyze_main() -> int:
             lint_paths,
             load_baseline,
             new_violations,
+            trace_paths,
         )
 
         t0 = time.perf_counter()
         report = lint_paths([os.path.join(repo, "hydragnn_tpu")], root=repo)
         fresh = new_violations(report, load_baseline())
+        t1 = time.perf_counter()
+        # The concurrency pass (suppression meta-check owned by the lint
+        # pass above — shared grammar, single catalogue).
+        trace = trace_paths(
+            [os.path.join(repo, "hydragnn_tpu")],
+            root=repo,
+            check_suppressions=False,
+        )
+        trace_fresh = new_violations(trace, load_baseline())
         result.update(
-            value=float(len(report.violations)),
-            lint_s=round(time.perf_counter() - t0, 3),
+            value=float(len(report.violations) + len(trace.violations)),
+            lint_s=round(t1 - t0, 3),
             files=report.files,
             traced_functions=report.traced_functions,
             rule_counts=report.counts(),
-            new_vs_baseline=len(fresh),
-            suppressions=len(report.suppressed),
-            suppression_reasons=[v.reason for v in report.suppressed],
+            new_vs_baseline=len(fresh) + len(trace_fresh),
+            suppressions=len(report.suppressed) + len(trace.suppressed),
+            suppression_reasons=[
+                v.reason for v in report.suppressed + trace.suppressed
+            ],
         )
+        from hydragnn_tpu.analysis.rules import CONCURRENCY_RULES
+
+        result["graftrace"] = {
+            "trace_s": round(time.perf_counter() - t1, 3),
+            "rule_counts": {
+                rule: n
+                for rule, n in trace.counts().items()
+                if rule in CONCURRENCY_RULES
+            },
+            "thread_roots": sorted(trace.thread_roots),
+            "shared_attrs": len(trace.shared_attrs),
+            "declared_attrs": trace.declared_attrs,
+            "lock_edges": len(trace.lock_edges),
+            "lock_cycles": trace.lock_cycles,
+        }
+        # The runtime half: the seeded HYDRAGNN_TSAN=1 drill in a FRESH
+        # process (class-level locks instrument at import time there).
+        t2 = time.perf_counter()
+        drill_proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "benchmarks", "tsan_drill.py"),
+                "--seed",
+                "0",
+                "--json",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=repo,
+            timeout=900,
+        )
+        try:
+            drill = json.loads(drill_proc.stdout.strip().splitlines()[-1])
+        except Exception:
+            drill = {
+                "ok": False,
+                "error": (drill_proc.stdout + drill_proc.stderr)[-800:],
+            }
+        result["tsan_drill"] = {
+            "drill_s": round(time.perf_counter() - t2, 3),
+            "ok": drill.get("ok", False),
+            "seed": drill.get("seed"),
+            "dynamic_inversions": drill.get("dynamic_inversions"),
+            "unregistered_cross_thread": drill.get(
+                "unregistered_cross_thread"
+            ),
+            "schedule_sha256": drill.get("schedule_sha256"),
+            **({"error": drill["error"]} if "error" in drill else {}),
+        }
 
         from hydragnn_tpu.analysis import check_config
 
@@ -870,7 +934,13 @@ def analyze_main() -> int:
         print(json.dumps(result))
         return 1
     print(json.dumps(result))
-    return 0 if result["new_vs_baseline"] == 0 and configs_ok else 1
+    ok = (
+        result["new_vs_baseline"] == 0
+        and configs_ok
+        and result["tsan_drill"]["ok"]
+        and not result["graftrace"]["lock_cycles"]
+    )
+    return 0 if ok else 1
 
 
 def serve_main() -> int:

@@ -213,7 +213,7 @@ def _run_arm(engine, graphs, duration_s, loads, hist=None) -> dict:
     """One engine through the full workload (closed + open sweep) under the
     recompile sentinel; returns the arm's measurement block."""
     warm_snap = engine.metrics.snapshot()["bucket_cache"]
-    buckets_after_warmup = len(engine._executables)
+    buckets_after_warmup = engine.compiled_buckets
     with engine.no_recompile(action="count") as watch:
         closed = closed_loop(engine, graphs, duration_s=duration_s, hist=hist)
         open_levels = [
@@ -236,7 +236,7 @@ def _run_arm(engine, graphs, duration_s, loads, hist=None) -> dict:
         # Executable-cache growth since warmup — robust to the per-level
         # metrics-window resets above: any steady-state compile adds an
         # entry to the engine-lifetime cache.
-        "recompiles_after_warmup": len(engine._executables)
+        "recompiles_after_warmup": engine.compiled_buckets
         - buckets_after_warmup,
         # XLA-level corroboration from the recompile sentinel: counts EVERY
         # backend compile during the measured load, engine-cache or not.

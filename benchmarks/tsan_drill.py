@@ -1,0 +1,178 @@
+"""Deterministic tsan drill over the serve + async-checkpoint paths.
+
+Runs the two concurrency-heavy subsystems with graftrace's runtime
+sanitizer enabled (analysis/tsan.py): every registered lock records its
+acquisition order, every registered shared-state site records which threads
+touched it under which locks, and the annotated yield points perturb thread
+interleavings under a SEEDED schedule — the same ``--seed`` replays the
+same perturbations, so a drill that exposes a race is a repro, not an
+anecdote.
+
+The drill then cross-checks what actually happened against the STATIC
+lock-order graph (``python -m hydragnn_tpu.analysis trace``): a dynamic
+acquisition order the static model missed, a dynamic inversion, or an
+unregistered cross-thread access all fail the run (exit 1).
+
+    HYDRAGNN_TSAN is forced on BEFORE any hydragnn import, so class-level
+    locks created at import time (Timer, FaultCounters) are instrumented
+    too — running this module IS the HYDRAGNN_TSAN=1 drill.
+
+    python benchmarks/tsan_drill.py [--seed N] [--json]
+
+Used by tests/test_concurrency_lint.py (same-seed determinism + clean-run
+assertions), .github/workflows/static-analysis.yml (short schedule-fuzz
+smoke over two seeds), and ``bench.py --analyze`` (drill outcome embedded
+in ANALYSIS_rNN.json).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+
+def _preparse(flag: str, argv, default: str) -> str:
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+_SEED = int(_preparse("--seed", sys.argv[1:], "0") or 0)
+
+# BEFORE any hydragnn/jax import: the tsan module reads these at import, and
+# class-level locks (Timer._lock, FaultCounters._lock) wrap only if the flag
+# is up when their defining modules load.
+os.environ["HYDRAGNN_TSAN"] = "1"
+os.environ["HYDRAGNN_TSAN_SEED"] = str(_SEED)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from hydragnn_tpu.analysis import tsan, trace_paths  # noqa: E402
+
+# Yield sites whose visit counts are workload-determined (not race-
+# determined), so their recorded decision streams must be bit-identical
+# across same-seed runs — the determinism witness the tests compare.
+_DETERMINISTIC_SITES = ("ckpt.save.pre_enqueue", "serve.submit.pre_enqueue")
+
+_CKPT_SAVES = 3
+_SERVE_REQUESTS = 8
+
+
+def _checkpoint_drill(tmpdir: str) -> None:
+    """Async-checkpoint path: N saves racing the daemon writer, a wait
+    barrier, close — the PR-5 lifecycle under schedule perturbation."""
+    from hydragnn_tpu.checkpoint.async_writer import AsyncCheckpointer
+
+    rng = np.random.default_rng(0)
+    variables = {
+        "params": {"w": rng.standard_normal((8, 8)).astype(np.float32)},
+        "batch_stats": {},
+    }
+    ac = AsyncCheckpointer()
+    try:
+        for k in range(_CKPT_SAVES):
+            ac.save(
+                variables,
+                None,
+                name="tsan_drill",
+                path=tmpdir,
+                meta={"epoch": k},
+                keep_last_k=2,
+            )
+        ac.wait()
+    finally:
+        ac.close()
+
+
+def _serve_drill() -> None:
+    """Serve path: submit/flush/dispatch/resolve across the batcher,
+    transfer, dispatch, and caller threads, then a drain-close."""
+    from benchmarks.serve_load import build_serving_engine
+
+    engine, graphs = build_serving_engine(
+        hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0,
+        pool_size=_SERVE_REQUESTS,
+    )
+    try:
+        futures = [engine.submit(g) for g in graphs[:_SERVE_REQUESTS]]
+        for f in futures:
+            f.result(timeout=120)
+        engine.metrics.render_prometheus()  # the /metrics cross-thread read
+    finally:
+        engine.close()
+
+
+def run_drill(seed: int) -> dict:
+    tsan.enable(seed=seed)
+    tsan.reset()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        _checkpoint_drill(tmpdir)
+        _serve_drill()
+    rep = tsan.report()
+    static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
+    cross = tsan.cross_check(static.lock_edges)
+    det = {s: tsan.schedule(s) for s in _DETERMINISTIC_SITES}
+    digest = hashlib.sha256(
+        json.dumps(det, sort_keys=True).encode()
+    ).hexdigest()
+    ok = (
+        cross["ok"]
+        and not rep["dynamic_inversions"]
+        and not rep["unregistered_cross_thread"]
+        and not static.lock_cycles
+        and not static.violations
+    )
+    return {
+        "seed": seed,
+        "ok": ok,
+        "dynamic_inversions": rep["dynamic_inversions"],
+        "unregistered_cross_thread": rep["unregistered_cross_thread"],
+        "dynamic_lock_edges": rep["lock_edges"],
+        "static_lock_edges": len(static.lock_edges),
+        "static_violations": len(static.violations),
+        "static_lock_cycles": static.lock_cycles,
+        "cross_check": cross,
+        "shared_sites": rep["shared_sites"],
+        "yield_counts": rep["yield_counts"],
+        "deterministic_sites": det,
+        "schedule_sha256": digest,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    result = run_drill(args.seed)
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(
+            f"tsan drill seed={result['seed']}: "
+            f"{len(result['dynamic_lock_edges'])} dynamic lock edge(s), "
+            f"{len(result['dynamic_inversions'])} inversion(s), "
+            f"{len(result['unregistered_cross_thread'])} unregistered "
+            f"cross-thread access(es), merged cycles: "
+            f"{result['cross_check']['merged_cycles']}, "
+            f"schedule {result['schedule_sha256'][:12]} — "
+            + ("OK" if result["ok"] else "FAIL")
+        )
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

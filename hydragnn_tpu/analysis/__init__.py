@@ -8,6 +8,9 @@
   and padded-arena buckets, before any device compile.
 * ``no_recompile`` — process-wide recompile sentinel (the serve engine's
   executable-cache accounting, generalized).
+* ``graftrace`` — static lock-discipline + thread-topology analyzer over the
+  host concurrency layer (concurrency.py), with an opt-in runtime
+  sanitizer half (tsan.py, ``HYDRAGNN_TSAN=1``).
 
 CLI: ``python -m hydragnn_tpu.analysis`` lints the package;
 ``python -m hydragnn_tpu.analysis check-config <json>`` checks a config.
@@ -24,6 +27,7 @@ from .baseline import (
     new_violations,
     save_baseline,
 )
+from .concurrency import TraceReport, trace_paths
 from .contracts import ConfigContractError, check_config, gate_config
 from .graftlint import Report, Violation, lint_paths
 from .sentinel import RecompileError, compile_count, no_recompile
@@ -33,6 +37,7 @@ __all__ = [
     "DEFAULT_BASELINE_PATH",
     "RecompileError",
     "Report",
+    "TraceReport",
     "Violation",
     "check_config",
     "compile_count",
@@ -42,4 +47,5 @@ __all__ = [
     "new_violations",
     "no_recompile",
     "save_baseline",
+    "trace_paths",
 ]

@@ -1,8 +1,14 @@
 """CLI for the static-analysis layer.
 
     python -m hydragnn_tpu.analysis [lint] [paths...] [--json]
-        Lint (default: the hydragnn_tpu package). Exit 0 iff no violation
-        beyond the committed baseline; --update-baseline rewrites it.
+        graftlint + graftrace (default: the hydragnn_tpu package). Exit 0
+        iff no violation beyond the committed baseline; --update-baseline
+        rewrites it. --no-trace restores the lint-only run.
+
+    python -m hydragnn_tpu.analysis trace [paths...] [--json]
+        graftrace alone: thread topology, lock discipline, lock-order
+        graph. Exit 0 iff clean vs baseline (unguarded-shared-write is
+        never baselineable).
 
     python -m hydragnn_tpu.analysis check-config <config.json>
         [--mode training|serving] [--bucket-ladder NxE,NxE] [--json]
@@ -23,7 +29,9 @@ from . import (
     load_baseline,
     new_violations,
     save_baseline,
+    trace_paths,
 )
+from . import rules as R
 from .contracts import ConfigContractError
 
 _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -33,27 +41,50 @@ def _lint_main(args) -> int:
     paths = args.paths or [_PACKAGE_DIR]
     root = os.path.dirname(_PACKAGE_DIR)
     report = lint_paths(paths, root=root)
+    trace = None
+    if not getattr(args, "no_trace", False):
+        # The lint pass already meta-checks every suppression (both
+        # grammars share rules.RULES), so the trace half skips its own
+        # suppression check to avoid double reports.
+        trace = trace_paths(paths, root=root, check_suppressions=False)
+        report.violations.extend(trace.violations)
+        report.suppressed.extend(trace.suppressed)
+        report.violations.sort(key=lambda v: (v.path, v.line, v.col))
+        report.suppressed.sort(key=lambda v: (v.path, v.line, v.col))
     baseline = load_baseline(args.baseline)
     fresh = new_violations(report, baseline)
     if args.update_baseline:
-        entries = save_baseline(report, args.baseline)
+        # A lint-only rewrite must not clobber the trace pass's entries in
+        # the shared file (the combined run rewrites everything); entries
+        # this report re-emits are dropped so counts don't inflate.
+        report_keys = {v.key for v in report.violations}
+        preserve = (
+            {
+                k: n
+                for k, n in baseline.items()
+                if k.rsplit("::", 1)[-1] in R.CONCURRENCY_RULES
+                and k not in report_keys
+            }
+            if trace is None
+            else None
+        )
+        entries = save_baseline(report, args.baseline, preserve=preserve)
         print(f"baseline updated: {len(entries)} entrie(s) at {args.baseline}")
         return 0
     if args.json:
-        print(
-            json.dumps(
-                {
-                    "files": report.files,
-                    "traced_functions": report.traced_functions,
-                    "rule_counts": report.counts(),
-                    "violations": [v.format() for v in report.violations],
-                    "new_violations": [v.format() for v in fresh],
-                    "suppressed": [v.format() for v in report.suppressed],
-                    "baseline_entries": sum(baseline.values()),
-                    "ok": not fresh,
-                }
-            )
-        )
+        doc = {
+            "files": report.files,
+            "traced_functions": report.traced_functions,
+            "rule_counts": report.counts(),
+            "violations": [v.format() for v in report.violations],
+            "new_violations": [v.format() for v in fresh],
+            "suppressed": [v.format() for v in report.suppressed],
+            "baseline_entries": sum(baseline.values()),
+            "ok": not fresh,
+        }
+        if trace is not None:
+            doc["trace"] = _trace_summary(trace)
+        print(json.dumps(doc))
     else:
         for v in report.violations:
             marker = "" if v.key in baseline else " [NEW]"
@@ -65,6 +96,77 @@ def _lint_main(args) -> int:
             f"{report.traced_functions} traced function(s), "
             f"{len(report.violations)} violation(s) "
             f"({len(fresh)} new vs baseline), "
+            f"{len(report.suppressed)} suppressed"
+        )
+        if trace is not None:
+            print(
+                f"graftrace: {len(trace.thread_roots)} thread root(s), "
+                f"{len(trace.shared_attrs)} shared attribute(s), "
+                f"{trace.declared_attrs} guard declaration(s), "
+                f"{len(trace.lock_edges)} lock-order edge(s), "
+                f"{len(trace.lock_cycles)} cycle(s)"
+            )
+    return 1 if fresh else 0
+
+
+def _trace_summary(report) -> dict:
+    return {
+        "thread_roots": report.thread_roots,
+        "shared_attrs": report.shared_attrs,
+        "declared_attrs": report.declared_attrs,
+        "lock_nodes": report.lock_nodes,
+        "lock_edges": [f"{a} -> {b}" for a, b in report.lock_edges],
+        "lock_cycles": report.lock_cycles,
+    }
+
+
+def _trace_main(args) -> int:
+    paths = args.paths or [_PACKAGE_DIR]
+    root = os.path.dirname(_PACKAGE_DIR)
+    report = trace_paths(paths, root=root)
+    baseline = load_baseline(args.baseline)
+    fresh = new_violations(report, baseline)
+    if args.update_baseline:
+        # Keep the lint pass's entries: this rewrite only owns the
+        # concurrency rules' rows in the shared baseline file. Entries this
+        # report RE-EMITS are dropped from the preserved set (a bare
+        # graftrace-rule suppression is flagged by both grammars under the
+        # same key — preserving AND re-adding would inflate its count).
+        report_keys = {v.key for v in report.violations}
+        preserve = {
+            k: n
+            for k, n in baseline.items()
+            if k.rsplit("::", 1)[-1] not in R.CONCURRENCY_RULES
+            and k not in report_keys
+        }
+        entries = save_baseline(report, args.baseline, preserve=preserve)
+        print(f"baseline updated: {len(entries)} entrie(s) at {args.baseline}")
+        return 0
+    if args.json:
+        doc = {
+            "files": report.files,
+            "rule_counts": report.counts(),
+            "violations": [v.format() for v in report.violations],
+            "new_violations": [v.format() for v in fresh],
+            "suppressed": [v.format() for v in report.suppressed],
+            "ok": not fresh,
+        }
+        doc.update(_trace_summary(report))
+        print(json.dumps(doc))
+    else:
+        for v in report.violations:
+            marker = "" if v.key in baseline else " [NEW]"
+            print(v.format() + marker)
+        for v in report.suppressed:
+            print(v.format() + f" — reason: {v.reason}")
+        roots = ", ".join(report.thread_roots) or "<none>"
+        print(
+            f"graftrace: {report.files} file(s); thread roots: {roots}; "
+            f"{len(report.shared_attrs)} shared attribute(s), "
+            f"{report.declared_attrs} guard declaration(s), "
+            f"{len(report.lock_edges)} lock-order edge(s), "
+            f"{len(report.lock_cycles)} cycle(s), "
+            f"{len(report.violations)} violation(s) ({len(fresh)} new), "
             f"{len(report.suppressed)} suppressed"
         )
     return 1 if fresh else 0
@@ -113,11 +215,25 @@ def build_parser() -> argparse.ArgumentParser:
         description="graftlint + static config contract checker",
     )
     sub = ap.add_subparsers(dest="cmd")
-    lint = sub.add_parser("lint", help="run graftlint (the default command)")
+    lint = sub.add_parser(
+        "lint", help="run graftlint + graftrace (the default command)"
+    )
     lint.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
     lint.add_argument("--json", action="store_true")
     lint.add_argument("--baseline", default=DEFAULT_BASELINE_PATH)
     lint.add_argument("--update-baseline", action="store_true")
+    lint.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="lint only (skip the graftrace concurrency pass)",
+    )
+    tr = sub.add_parser(
+        "trace", help="graftrace: thread topology + lock discipline"
+    )
+    tr.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
+    tr.add_argument("--json", action="store_true")
+    tr.add_argument("--baseline", default=DEFAULT_BASELINE_PATH)
+    tr.add_argument("--update-baseline", action="store_true")
     cc = sub.add_parser("check-config", help="static config contract check")
     cc.add_argument("config")
     cc.add_argument(
@@ -137,11 +253,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # Default subcommand: bare invocation (or paths/flags only) means lint.
-    if not argv or argv[0] not in ("lint", "check-config", "-h", "--help"):
+    if not argv or argv[0] not in ("lint", "trace", "check-config", "-h", "--help"):
         argv = ["lint"] + argv
     args = build_parser().parse_args(argv)
     if args.cmd == "check-config":
         return _check_config_main(args)
+    if args.cmd == "trace":
+        return _trace_main(args)
     return _lint_main(args)
 
 

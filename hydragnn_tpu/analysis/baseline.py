@@ -13,12 +13,19 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .graftlint import Report, Violation
 
-# Rules that may never carry baseline entries.
-NO_BASELINE_RULES = ("host-sync-in-step", "cond-in-guard")
+# Rules that may never carry baseline entries. unguarded-shared-write joins
+# the original two (ISSUE 8): a grandfathered lost-update race corrupts
+# counters/caches silently — it must be fixed or inline-suppressed with a
+# reason, never tolerated by count.
+NO_BASELINE_RULES = (
+    "host-sync-in-step",
+    "cond-in-guard",
+    "unguarded-shared-write",
+)
 
 DEFAULT_BASELINE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json"
@@ -44,10 +51,18 @@ def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Dict[str, int]:
     return {str(k): int(v) for k, v in entries.items()}
 
 
-def save_baseline(report: Report, path: str = DEFAULT_BASELINE_PATH) -> Dict[str, int]:
+def save_baseline(
+    report: Report,
+    path: str = DEFAULT_BASELINE_PATH,
+    preserve: Optional[Dict[str, int]] = None,
+) -> Dict[str, int]:
     """Write the report's violations as the new baseline (refusing the
-    never-grandfathered rules — those must be fixed, not recorded)."""
-    entries: Dict[str, int] = {}
+    never-grandfathered rules — those must be fixed, not recorded).
+
+    ``preserve`` carries existing entries to keep verbatim: a single-pass
+    ``--update-baseline`` (``trace``, or ``lint --no-trace``) must not
+    clobber the OTHER pass's grandfathered entries in the shared file."""
+    entries: Dict[str, int] = dict(preserve or {})
     refused: List[Violation] = []
     for v in report.violations:
         if v.rule in NO_BASELINE_RULES:

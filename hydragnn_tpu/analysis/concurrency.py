@@ -1,0 +1,1208 @@
+"""graftrace — static lock-discipline + thread-topology analyzer for the
+host concurrency layer (rule catalogue: rules.py, policy + examples:
+docs/STATIC_ANALYSIS.md "graftrace").
+
+graftlint deliberately analyzes code reachable from compiled step bodies;
+this pass covers its blind spot: the five cooperating host-side thread
+roots — the ``_Prefetcher``/``DeviceFeed`` pipeline threads, the serve
+engine's batcher + dispatcher + HTTP handler threads, the checkpoint
+writer daemon, and the supervisor loop — and the shared state they touch
+(metrics counters, executable caches, manifests, queues).
+
+Three passes over the same parsed-module/callgraph infrastructure the
+linter owns (Tracer subclasses graftlint.Linter):
+
+1. **Thread topology.** Thread roots are discovered statically —
+   ``threading.Thread(target=...)`` (root named by the ``name=`` literal),
+   ``BaseHTTPRequestHandler`` subclasses (per-connection handler threads),
+   and the framework's higher-order bindings (``DeviceFeed(iterable,
+   transfer=...)`` runs its arguments on the feed-host / feed-transfer
+   threads — rules.THREAD_CALLABLE_BINDINGS, the runs-on analog of
+   TRACED_FACTORIES). Every other function starts on ``main``; the
+   runs-on-thread set is propagated over the static call graph (direct
+   calls, ``self.`` methods, ``Class.method`` refs, ``self.attr.method``
+   through inferred attribute types, and cross-module imports) to a
+   fixpoint, exactly the way rules.py propagates tracedness.
+
+2. **Shared-state inventory + lock discipline.** Attribute writes are
+   inventoried per ``(module, class, attr)``; ``__init__`` writes are
+   pre-publication and exempt. An attribute written from >= 2 thread roots
+   must carry a ``# guarded-by:`` declaration (grammar below), and every
+   declared attribute's access sites must be statically enclosed in a
+   ``with <declared lock>:``. A dynamic ``setattr(self, name, ...)`` with a
+   non-literal name is conservatively a write to EVERY attribute of the
+   class. Rules: ``missing-guard-decl``, ``unguarded-shared-write`` (never
+   baselineable), ``guard-mismatch``.
+
+3. **Lock-order graph + hazards.** ``with`` nesting (including through
+   calls, via each function's transitive may-acquire set) yields a static
+   lock-order graph; cycles are ``lock-order-inversion``. Unbounded
+   blocking ops (queue get/put/join, Event.wait, Thread.join — typed from
+   ``__init__`` construction) while holding a lock are
+   ``blocking-queue-in-lock``; ``os.fork``/fork-context multiprocessing in
+   this thread-spawning package is ``fork-after-threads``; JAX dispatch
+   from a non-sanctioned root is ``jax-dispatch-off-main``.
+
+``guarded-by`` declaration grammar (comment on the attribute's assignment
+line or the line above)::
+
+    self.requests_total = 0          # guarded-by: self._lock
+    self.latency = {...}             # guarded-by: self._lock, dirty-reads(immutable after construction)
+    self._result = None              # guarded-by: none(at-most-once overwrite; Event.set is the barrier)
+    self.graphs = {}                 # guarded-by: external(callers hold their own lock)
+
+``none``/``external`` REQUIRE the parenthesized reason — an unexplained
+lock-free field is a prose invariant again. ``dirty-reads(<reason>)``
+exempts read sites only; writes always need the lock.
+
+Known under-approximations (documented, deliberate): objects that escape
+through opaque iterators (a loader consumed by the feed's host thread) keep
+their statically-visible roots; reads are checked for ``self.X``/``cls.X``/
+``Class.X`` forms, not through arbitrary object references. Both err toward
+silence, never toward false alarms — the suppression budget stays honest.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import rules as R
+from .graftlint import (
+    _FUNC_NODES,
+    FuncInfo,
+    Linter,
+    ModuleInfo,
+    Report,
+    Violation,
+    _dotted,
+    _own_walk,
+)
+
+_GUARD_RE = re.compile(
+    r"#\s*guarded-by:\s*"
+    r"(?P<lock>none(?![\w.])|external(?![\w.])|[A-Za-z_][\w.]*)"
+    r"\s*(?:\((?P<reason>[^)]*)\))?"
+    r"\s*(?:,\s*dirty-reads\s*\((?P<dirty>[^)]*)\))?"
+)
+
+
+@dataclass
+class GuardDecl:
+    lock: str  # canonical lock id, or "none" / "external"
+    line: int
+    reason: Optional[str] = None  # required for none/external
+    dirty_reads: Optional[str] = None  # reason unlocked reads are safe
+    # True when the comment is the whole line: only a standalone comment may
+    # declare for the assignment BELOW it — a trailing comment always binds
+    # to its own line's attribute, never the next one's.
+    standalone: bool = True
+
+
+@dataclass
+class AttrInfo:
+    """One shared-state candidate: an attribute of a class (or a module
+    global mutated from functions)."""
+
+    key: Tuple[str, str, str]  # (relpath, class or "<module>", attr)
+    ctor_type: Optional[str] = None  # canonical constructor, if inferable
+    self_sync: bool = False  # rules.THREAD_SAFE_TYPES construction
+    is_lock: bool = False
+    decl: Optional[GuardDecl] = None
+    writes: List[Tuple[FuncInfo, ast.AST, bool]] = field(default_factory=list)
+    # (fn, node, in_init); reads exclude __init__ sites
+    reads: List[Tuple[FuncInfo, ast.AST]] = field(default_factory=list)
+
+    @property
+    def write_roots(self) -> Set[str]:
+        roots: Set[str] = set()
+        for fn, _node, in_init in self.writes:
+            if not in_init:
+                roots |= fn.roots
+        return roots
+
+
+@dataclass
+class TraceReport(Report):
+    """graftrace run result: graftlint's Report plus the topology/lock-graph
+    facts the runtime half (tsan.py) cross-checks."""
+
+    thread_roots: Dict[str, List[str]] = field(default_factory=dict)
+    shared_attrs: List[str] = field(default_factory=list)
+    declared_attrs: int = 0
+    lock_nodes: List[str] = field(default_factory=list)
+    lock_edges: List[Tuple[str, str]] = field(default_factory=list)
+    lock_cycles: List[List[str]] = field(default_factory=list)
+
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition")
+
+
+def _is_constant_name(attr: str) -> bool:
+    """ALL_CAPS attributes are class constants by convention — assigned once
+    at class-definition time, immutable thereafter; the dynamic-setattr taint
+    must not demand guard declarations for them."""
+    bare = attr.lstrip("_")
+    return bool(bare) and bare == bare.upper() and any(c.isalpha() for c in bare)
+
+
+class Tracer(Linter):
+    """The graftrace pass. Reuses the linter's parsing, import resolution,
+    and suppression machinery; adds thread roots, attribute inventory, and
+    the lock graph."""
+
+    def __init__(self, paths: Sequence[str], root: Optional[str] = None):
+        super().__init__(paths, root=root)
+        # (relpath, ClassName) -> ModuleInfo (class definition site)
+        self.classes: Dict[Tuple[str, str], ModuleInfo] = {}
+        # class name -> [(mod, name)] for simple-name resolution
+        self._class_sites: Dict[str, List[Tuple[ModuleInfo, str]]] = {}
+        # (mod.relpath, cls, attr) -> (def_mod.relpath, def_cls) attr type
+        self.attr_types: Dict[Tuple[str, str, str], Tuple[str, str]] = {}
+        self.attrs: Dict[Tuple[str, str, str], AttrInfo] = {}
+        self.guard_decls: Dict[str, Dict[int, GuardDecl]] = {}
+        # lock graph: canonical lock id -> {successor: (mod, node, fn)}
+        self.lock_graph: Dict[str, Dict[str, Tuple[ModuleInfo, ast.AST, str]]] = {}
+        self._fn_acquires: Dict[int, Set[str]] = {}  # id(fn) -> lock ids
+        self._fn_blocks: Dict[int, Optional[str]] = {}  # id(fn) -> blocking-op desc
+        self.http_handler_classes: Set[Tuple[str, str]] = set()
+        self.roots_found: Dict[str, List[str]] = {}
+
+    # ------------------------------------------------------------------ run
+    def run(self, check_suppressions: bool = True) -> TraceReport:  # type: ignore[override]
+        report = TraceReport()
+        self.load(report)
+        self._index_classes()
+        self._collect_guard_comments()
+        self._infer_attr_types()
+        self._discover_roots()
+        self._propagate_roots()
+        self._inventory_attrs()
+        self._check_guards(report)
+        self._build_lock_graph(report)
+        self._check_lock_cycles(report)
+        self._check_blocking_and_forks(report)
+        self._check_jax_dispatch(report)
+        if check_suppressions:
+            self._check_bare_suppressions(report)
+        report.thread_roots = {
+            k: sorted(v) for k, v in sorted(self.roots_found.items())
+        }
+        report.shared_attrs = sorted(
+            "::".join(a.key)
+            for a in self.attrs.values()
+            if len(a.write_roots) >= 2
+        )
+        report.declared_attrs = sum(
+            1 for a in self.attrs.values() if a.decl is not None
+        )
+        report.lock_nodes = sorted(self.lock_graph)
+        report.lock_edges = sorted(
+            (a, b) for a, succ in self.lock_graph.items() for b in succ
+        )
+        report.violations.sort(key=lambda v: (v.path, v.line, v.col))
+        report.suppressed.sort(key=lambda v: (v.path, v.line, v.col))
+        return report
+
+    # ------------------------------------------------------------- indexing
+    def _index_classes(self) -> None:
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes[(mod.relpath, node.name)] = mod
+                    self._class_sites.setdefault(node.name, []).append(
+                        (mod, node.name)
+                    )
+                    for base in node.bases:
+                        tail = (_dotted(base) or "").split(".")[-1]
+                        if tail in R.HTTP_HANDLER_BASES:
+                            self.http_handler_classes.add(
+                                (mod.relpath, node.name)
+                            )
+
+    def _resolve_class(
+        self, mod: ModuleInfo, name: str
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        """A simple class name in ``mod``'s scope -> its defining module."""
+        if (mod.relpath, name) in self.classes:
+            return mod, name
+        imp = mod.from_imports.get(name)
+        if imp:
+            src = self.by_dotted.get(imp[0])
+            if src and (src.relpath, imp[1]) in self.classes:
+                return src, imp[1]
+        return None
+
+    def _collect_guard_comments(self) -> None:
+        for mod in self.modules:
+            decls: Dict[int, GuardDecl] = {}
+            try:
+                toks = tokenize.generate_tokens(
+                    io.StringIO(mod.source).readline
+                )
+                for tok in toks:
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _GUARD_RE.search(tok.string)
+                    if not m:
+                        continue
+                    reason = m.group("reason")
+                    dirty = m.group("dirty")
+                    decls[tok.start[0]] = GuardDecl(
+                        lock=m.group("lock"),
+                        line=tok.start[0],
+                        reason=reason.strip() if reason else None,
+                        dirty_reads=dirty.strip() if dirty else None,
+                        standalone=not tok.line[: tok.start[1]].strip(),
+                    )
+            except tokenize.TokenError:
+                pass
+            self.guard_decls[mod.relpath] = decls
+
+    # -------------------------------------------------------- type inference
+    def _infer_attr_types(self) -> None:
+        """``self.X = ServeMetrics()`` / ``self.X = <annotated param>`` ->
+        (defining module, class) for ``self.X.method`` resolution and for
+        thread-safe/lock typing."""
+        for mod in self.modules:
+            for fn in mod.functions:
+                cls = self._enclosing_class(fn)
+                if cls is None:
+                    continue
+                ann = self._param_annotations(mod, fn)
+                for node in _own_walk(fn.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        d = _dotted(t)
+                        if not d or "." not in d:
+                            continue
+                        head, _, attr = d.partition(".")
+                        if head not in ("self", "cls") or "." in attr:
+                            continue
+                        key = (mod.relpath, cls, attr)
+                        typed = self._expr_class(mod, node.value, ann)
+                        if typed and key not in self.attr_types:
+                            self.attr_types[key] = (
+                                typed[0].relpath,
+                                typed[1],
+                            )
+
+    def _param_annotations(
+        self, mod: ModuleInfo, fn: FuncInfo
+    ) -> Dict[str, Tuple[ModuleInfo, str]]:
+        out: Dict[str, Tuple[ModuleInfo, str]] = {}
+        args = getattr(fn.node, "args", None)
+        if args is None:
+            return out
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is None:
+                continue
+            t = self._annotation_class(mod, a.annotation)
+            if t:
+                out[a.arg] = t
+        return out
+
+    def _annotation_class(
+        self, mod: ModuleInfo, node: ast.AST
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        if isinstance(node, ast.Subscript):  # Optional[X] / "X" | None
+            return self._annotation_class(mod, node.slice)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            name = node.value.split(".")[-1].strip("'\" ")
+            return self._resolve_class(mod, name)
+        d = _dotted(node)
+        if d:
+            return self._resolve_class(mod, d.split(".")[-1])
+        return None
+
+    def _expr_class(
+        self,
+        mod: ModuleInfo,
+        expr: ast.AST,
+        ann: Dict[str, Tuple[ModuleInfo, str]],
+    ) -> Optional[Tuple[ModuleInfo, str]]:
+        """First analyzed-class constructor call (or annotated-param name)
+        found anywhere in the RHS expression."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d:
+                    resolved = self._resolve_class(mod, d.split(".")[-1])
+                    if resolved:
+                        return resolved
+            elif isinstance(node, ast.Name) and node.id in ann:
+                return ann[node.id]
+        return None
+
+    @staticmethod
+    def _enclosing_class(fn: FuncInfo) -> Optional[str]:
+        cur: Optional[FuncInfo] = fn
+        while cur is not None:
+            if cur.class_name:
+                return cur.class_name
+            cur = cur.parent
+        return None
+
+    # --------------------------------------------------------- thread roots
+    def _add_root(self, root: str, fn: Optional[FuncInfo], where: str) -> None:
+        self.roots_found.setdefault(root, [])
+        if fn is not None:
+            fn.roots.add(root)
+            self.roots_found[root].append(f"{where}::{fn.qualname}")
+        else:
+            self.roots_found[root].append(f"{where}::<external>")
+
+    def _resolve_callable_arg(
+        self, mod: ModuleInfo, fn: FuncInfo, arg: ast.AST
+    ) -> Optional[FuncInfo]:
+        """The function a callable/generator argument executes: a name, a
+        ``self.method`` ref, a lambda, a generator call ``self.gen(...)``,
+        or ``map(f, ...)``'s first argument."""
+        if isinstance(arg, ast.Lambda):
+            return mod.func_by_node.get(arg)
+        if isinstance(arg, ast.Call):
+            callee = _dotted(arg.func)
+            if callee == "map" and arg.args:
+                return self._resolve_callable_arg(mod, fn, arg.args[0])
+            if callee:
+                return self._resolve_call_ext(mod, fn, callee)
+            return None
+        d = _dotted(arg)
+        if d:
+            return self._resolve_call_ext(mod, fn, d)
+        return None
+
+    def _discover_roots(self) -> None:
+        for mod in self.modules:
+            # HTTP handler classes: every method runs on a connection thread.
+            for fn in mod.functions:
+                if (
+                    fn.class_name
+                    and (mod.relpath, fn.class_name)
+                    in self.http_handler_classes
+                ):
+                    self._add_root(R.HTTP_HANDLER_ROOT, fn, mod.relpath)
+                # Nested defs of the declared thread factories.
+                p = fn.parent
+                while p is not None:
+                    if p.name in R.THREAD_FACTORY_ROOTS:
+                        self._add_root(
+                            R.THREAD_FACTORY_ROOTS[p.name], fn, mod.relpath
+                        )
+                        break
+                    p = p.parent
+            for fn in mod.functions:
+                for dotted, call in fn.calls:
+                    tail = dotted.split(".")[-1]
+                    canon = mod.canonical(dotted) or ""
+                    if (
+                        tail == "Thread"
+                        or canon in ("threading.Thread",)
+                        or canon.endswith(".threading.Thread")
+                    ):
+                        target = None
+                        name = None
+                        for kw in call.keywords:
+                            if kw.arg == "target":
+                                target = kw.value
+                            elif kw.arg == "name" and isinstance(
+                                kw.value, ast.Constant
+                            ):
+                                name = str(kw.value.value)
+                        if target is None:
+                            continue
+                        tfn = self._resolve_callable_arg(mod, fn, target)
+                        root = name or (
+                            tfn.qualname if tfn else (_dotted(target) or "?")
+                        )
+                        self._add_root(root, tfn, mod.relpath)
+                    elif tail in R.THREAD_CALLABLE_BINDINGS:
+                        binding = R.THREAD_CALLABLE_BINDINGS[tail]
+                        for i, arg in enumerate(call.args):
+                            if i in binding:
+                                tfn = self._resolve_callable_arg(mod, fn, arg)
+                                if tfn is not None:
+                                    self._add_root(
+                                        binding[i], tfn, mod.relpath
+                                    )
+                        for kw in call.keywords:
+                            if kw.arg in binding:
+                                tfn = self._resolve_callable_arg(
+                                    mod, fn, kw.value
+                                )
+                                if tfn is not None:
+                                    self._add_root(
+                                        binding[kw.arg], tfn, mod.relpath
+                                    )
+
+    def _resolve_call_ext(
+        self, mod: ModuleInfo, fn: FuncInfo, dotted: str
+    ) -> Optional[FuncInfo]:
+        """Linter resolution + Class.method, self.attr.method (typed), and
+        constructor-to-__init__ edges."""
+        base = self._resolve_call(mod, fn, dotted)
+        if base is not None:
+            return base
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            resolved = self._resolve_class(mod, parts[0])
+            if resolved:
+                dmod, cname = resolved
+                return dmod.methods.get((cname, "__init__"))
+            return None
+        if len(parts) == 2:
+            resolved = self._resolve_class(mod, parts[0])
+            if resolved:
+                dmod, cname = resolved
+                return dmod.methods.get((cname, parts[1]))
+        if len(parts) == 3 and parts[0] in ("self", "cls"):
+            cls = self._enclosing_class(fn)
+            if cls:
+                t = self.attr_types.get((mod.relpath, cls, parts[1]))
+                if t:
+                    dmod = next(
+                        (m for m in self.modules if m.relpath == t[0]), None
+                    )
+                    if dmod:
+                        return dmod.methods.get((t[1], parts[2]))
+        return None
+
+    def _propagate_roots(self) -> None:
+        # Everything not exclusively a thread body starts on main.
+        for mod in self.modules:
+            for fn in mod.functions:
+                if not fn.roots:
+                    fn.roots.add(R.MAIN_THREAD_ROOT)
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules:
+                for fn in mod.functions:
+                    for dotted, _ in fn.calls:
+                        target = self._resolve_call_ext(mod, fn, dotted)
+                        if target is None:
+                            continue
+                        if not fn.roots <= target.roots:
+                            target.roots |= fn.roots
+                            changed = True
+
+    # -------------------------------------------------- attribute inventory
+    def _attr_of_target(
+        self, mod: ModuleInfo, fn: FuncInfo, node: ast.AST
+    ) -> Optional[Tuple[str, str, str]]:
+        """(relpath, class-or-<module>, attr) for self.X / cls.X / Class.X
+        targets, and module globals (Name known at module level)."""
+        d = _dotted(node)
+        if not d:
+            return None
+        parts = d.split(".")
+        if len(parts) == 2:
+            if parts[0] in ("self", "cls"):
+                cls = self._enclosing_class(fn)
+                if cls:
+                    return (mod.relpath, cls, parts[1])
+                return None
+            resolved = self._resolve_class(mod, parts[0])
+            if resolved:
+                dmod, cname = resolved
+                return (dmod.relpath, cname, parts[1])
+            return None
+        if len(parts) == 1:
+            if self._is_module_global(mod, fn, parts[0]):
+                return (mod.relpath, "<module>", parts[0])
+        return None
+
+    def _is_module_global(
+        self, mod: ModuleInfo, fn: FuncInfo, name: str
+    ) -> bool:
+        globals_ = self._module_globals(mod)
+        if name not in globals_:
+            return False
+        # Shadowed by a parameter or a local plain assignment?
+        args = getattr(fn.node, "args", None)
+        if args is not None:
+            names = {a.arg for a in args.args + args.kwonlyargs}
+            if args.vararg:
+                names.add(args.vararg.arg)
+            if args.kwarg:
+                names.add(args.kwarg.arg)
+            if name in names:
+                return False
+        for node in _own_walk(fn.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return False
+        return True
+
+    def _module_globals(self, mod: ModuleInfo) -> Set[str]:
+        cached = getattr(mod, "_trace_globals", None)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                out.add(stmt.target.id)
+        mod._trace_globals = out  # type: ignore[attr-defined]
+        return out
+
+    def _attr_info(self, key: Tuple[str, str, str]) -> AttrInfo:
+        info = self.attrs.get(key)
+        if info is None:
+            info = self.attrs[key] = AttrInfo(key=key)
+        return info
+
+    def _is_init(self, fn: FuncInfo, key: Tuple[str, str, str]) -> bool:
+        """Pre-publication writes: inside the owning class's __init__ (or
+        functions nested in it)."""
+        cur: Optional[FuncInfo] = fn
+        while cur is not None:
+            if cur.name == "__init__" and self._enclosing_class(cur) == key[1]:
+                return True
+            cur = cur.parent
+        return False
+
+    def _note_assignment(
+        self,
+        mod: ModuleInfo,
+        fn: Optional[FuncInfo],
+        key: Tuple[str, str, str],
+        node: ast.AST,
+        value: Optional[ast.AST],
+    ) -> None:
+        info = self._attr_info(key)
+        line = getattr(node, "lineno", 0)
+        decls = self.guard_decls.get(mod.relpath, {})
+        for probe in (line, line - 1):
+            d = decls.get(probe)
+            if d and probe == line - 1 and not d.standalone:
+                d = None  # a trailing comment binds to ITS line's attribute
+            if d and info.decl is None:
+                info.decl = GuardDecl(
+                    lock=self._canonical_decl_lock(mod, key, d.lock),
+                    line=d.line,
+                    reason=d.reason,
+                    dirty_reads=d.dirty_reads,
+                )
+        if value is not None and info.ctor_type is None:
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Call):
+                    canon = mod.canonical(_dotted(sub.func)) or ""
+                    tail2 = ".".join(canon.split(".")[-2:])
+                    for probe_t in (canon, tail2):
+                        if (
+                            probe_t in R.THREAD_SAFE_TYPES
+                            or probe_t in R.BLOCKING_METHODS_BY_TYPE
+                            or probe_t in _LOCK_CTORS
+                        ):
+                            info.ctor_type = probe_t
+                            info.self_sync = probe_t in R.THREAD_SAFE_TYPES
+                            info.is_lock = probe_t in _LOCK_CTORS
+                            break
+                    if info.ctor_type:
+                        break
+        in_init = fn is None or self._is_init(fn, key)
+        if fn is not None:
+            info.writes.append((fn, node, in_init))
+
+    def _canonical_decl_lock(
+        self, mod: ModuleInfo, key: Tuple[str, str, str], lock: str
+    ) -> str:
+        if lock in ("none", "external"):
+            return lock
+        return self._canonical_lock(mod, key[1], lock)
+
+    def _canonical_lock(
+        self, mod: ModuleInfo, cls: Optional[str], expr: str
+    ) -> str:
+        """Canonical lock id for a dotted lock expression in (mod, class)
+        context: ``self._lock``/``cls._lock`` -> ``mod::Class._lock``;
+        ``Other._lock`` resolves through imports; bare names are module
+        globals."""
+        parts = expr.split(".")
+        if len(parts) == 2 and parts[0] in ("self", "cls") and cls:
+            return f"{mod.relpath}::{cls}.{parts[1]}"
+        if len(parts) == 2:
+            resolved = self._resolve_class(mod, parts[0])
+            if resolved:
+                dmod, cname = resolved
+                return f"{dmod.relpath}::{cname}.{parts[1]}"
+        if len(parts) == 3 and parts[0] in ("self", "cls") and cls:
+            t = self.attr_types.get((mod.relpath, cls, parts[1]))
+            if t:
+                return f"{t[0]}::{t[1]}.{parts[2]}"
+        if len(parts) == 1:
+            return f"{mod.relpath}::{expr}"
+        return f"{mod.relpath}::<expr>{expr}"
+
+    def _inventory_attrs(self) -> None:
+        for mod in self.modules:
+            # Class-body assignments (class attrs, incl. their decls/types).
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for stmt in node.body:
+                    tgt = None
+                    val = None
+                    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                        tgt, val = stmt.targets[0], stmt.value
+                    elif isinstance(stmt, ast.AnnAssign):
+                        tgt, val = stmt.target, stmt.value
+                    if isinstance(tgt, ast.Name):
+                        self._note_assignment(
+                            mod,
+                            None,
+                            (mod.relpath, node.name, tgt.id),
+                            stmt,
+                            val,
+                        )
+            # Module-level globals (decl + ctor typing).
+            for stmt in mod.tree.body:
+                tgt = None
+                val = None
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    tgt, val = stmt.targets[0], stmt.value
+                elif isinstance(stmt, ast.AnnAssign):
+                    tgt, val = stmt.target, stmt.value
+                if isinstance(tgt, ast.Name):
+                    self._note_assignment(
+                        mod,
+                        None,
+                        (mod.relpath, "<module>", tgt.id),
+                        stmt,
+                        val,
+                    )
+            # Function-body writes and reads.
+            for fn in mod.functions:
+                self._inventory_fn(mod, fn)
+
+    def _inventory_fn(self, mod: ModuleInfo, fn: FuncInfo) -> None:
+        for node in _own_walk(fn.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                value = getattr(node, "value", None)
+                for t in targets:
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        base = base.value  # container-element write
+                    if isinstance(base, (ast.Tuple, ast.List)):
+                        for elt in base.elts:
+                            key = self._attr_of_target(mod, fn, elt)
+                            if key:
+                                self._note_assignment(
+                                    mod, fn, key, node, value
+                                )
+                        continue
+                    key = self._attr_of_target(mod, fn, base)
+                    if key:
+                        self._note_assignment(mod, fn, key, node, value)
+            elif isinstance(node, ast.Call):
+                self._inventory_call(mod, fn, node)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                key = self._attr_of_target(mod, fn, node)
+                if key and not self._is_init(fn, key):
+                    self._attr_info(key).reads.append((fn, node))
+
+    def _inventory_call(
+        self, mod: ModuleInfo, fn: FuncInfo, node: ast.Call
+    ) -> None:
+        # Container mutators: self.X.append(...) is a write to X.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in R.MUTATOR_METHODS
+        ):
+            key = self._attr_of_target(mod, fn, node.func.value)
+            if key:
+                info = self._attr_info(key)
+                if not info.self_sync:
+                    self._note_assignment(mod, fn, key, node, None)
+        # Dynamic setattr: non-literal name taints every attr of the class.
+        callee = _dotted(node.func)
+        if callee == "setattr" and len(node.args) >= 2:
+            obj, name_arg = node.args[0], node.args[1]
+            target_cls: Optional[Tuple[str, str]] = None
+            d = _dotted(obj)
+            if d in ("self", "cls"):
+                cls = self._enclosing_class(fn)
+                if cls:
+                    target_cls = (mod.relpath, cls)
+            elif d and d.startswith("self.") and d.count(".") == 1:
+                cls = self._enclosing_class(fn)
+                t = (
+                    self.attr_types.get((mod.relpath, cls, d.split(".")[1]))
+                    if cls
+                    else None
+                )
+                if t:
+                    target_cls = t
+            if target_cls is None:
+                return
+            if isinstance(name_arg, ast.Constant) and isinstance(
+                name_arg.value, str
+            ):
+                self._note_assignment(
+                    mod,
+                    fn,
+                    (target_cls[0], target_cls[1], name_arg.value),
+                    node,
+                    None,
+                )
+            else:
+                for key, info in list(self.attrs.items()):
+                    if (
+                        key[0] == target_cls[0]
+                        and key[1] == target_cls[1]
+                        and not info.self_sync
+                        and not info.is_lock
+                        and not _is_constant_name(key[2])
+                    ):
+                        info.writes.append((fn, node, False))
+
+    # ------------------------------------------------------ guard discipline
+    def _held_locks_map(
+        self, mod: ModuleInfo, fn: FuncInfo
+    ) -> Dict[int, frozenset]:
+        """id(node) -> frozenset of canonical lock ids held at that node
+        (intra-procedural ``with`` nesting)."""
+        cached = getattr(fn, "_trace_held", None)
+        if cached is not None:
+            return cached
+        held_map: Dict[int, frozenset] = {}
+        cls = self._enclosing_class(fn)
+
+        def lock_ids(item: ast.withitem) -> Optional[str]:
+            d = _dotted(item.context_expr)
+            if not d:
+                return None
+            lock_id = self._canonical_lock(mod, cls, d)
+            info = self.attrs.get(self._lock_attr_key(lock_id))
+            if info is not None and info.is_lock:
+                return lock_id
+            # Unknown object: treat names/attrs containing "lock" as locks
+            # (fixture files declare locks the checker has not typed).
+            if "lock" in d.split(".")[-1].lower():
+                return lock_id
+            return None
+
+        def annotate(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, _FUNC_NODES) and node is not fn.node:
+                return  # nested defs hold nothing from the enclosing scope
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held_map[id(node)] = held
+                new = held
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        held_map[id(sub)] = new
+                    lid = lock_ids(item)
+                    if lid is not None:
+                        for h in new:
+                            self._add_lock_edge(h, lid, mod, node, fn)
+                        new = new | {lid}
+                        self._fn_acquires.setdefault(id(fn), set()).add(lid)
+                    if item.optional_vars is not None:
+                        for sub in ast.walk(item.optional_vars):
+                            held_map[id(sub)] = new
+                for child in node.body:
+                    annotate(child, new)
+                return
+            held_map[id(node)] = held
+            for child in ast.iter_child_nodes(node):
+                annotate(child, held)
+
+        annotate(fn.node, frozenset())
+        fn._trace_held = held_map  # type: ignore[attr-defined]
+        return held_map
+
+    @staticmethod
+    def _lock_attr_key(lock_id: str) -> Tuple[str, str, str]:
+        relpath, _, rest = lock_id.partition("::")
+        if "." in rest:
+            cls, _, attr = rest.partition(".")
+            return (relpath, cls, attr)
+        return (relpath, "<module>", rest)
+
+    def _add_lock_edge(
+        self, a: str, b: str, mod: ModuleInfo, node: ast.AST, fn: FuncInfo
+    ) -> None:
+        if a == b:
+            return
+        self.lock_graph.setdefault(a, {})
+        self.lock_graph.setdefault(b, {})
+        self.lock_graph[a].setdefault(b, (mod, node, fn.qualname))
+
+    def _check_guards(self, report: TraceReport) -> None:
+        mods_by_rel = {m.relpath: m for m in self.modules}
+        for key, info in sorted(self.attrs.items()):
+            if info.self_sync or info.is_lock:
+                continue
+            shared_roots = info.write_roots
+            decl = info.decl
+            if decl is None:
+                if len(shared_roots) >= 2:
+                    fn, node, _ = next(
+                        (w for w in info.writes if not w[2]), info.writes[0]
+                    )
+                    self._emit(
+                        report,
+                        fn.module,
+                        "missing-guard-decl",
+                        node,
+                        f"attribute {key[1]}.{key[2]} is written from "
+                        f"thread roots {sorted(shared_roots)} but carries "
+                        "no '# guarded-by:' declaration",
+                        fn.qualname,
+                    )
+                continue
+            if decl.lock in ("none", "external"):
+                if not decl.reason:
+                    mod = mods_by_rel.get(key[0])
+                    if mod is not None:
+                        report.violations.append(
+                            Violation(
+                                rule="missing-guard-decl",
+                                path=key[0],
+                                line=decl.line,
+                                col=0,
+                                message=(
+                                    f"guarded-by: {decl.lock} on "
+                                    f"{key[1]}.{key[2]} requires a reason: "
+                                    f"# guarded-by: {decl.lock}(why this "
+                                    "is safe)"
+                                ),
+                                qualname=f"{key[1]}.{key[2]}",
+                            )
+                        )
+                continue
+            # Declared lock: every non-init write must hold it; reads too
+            # unless the declaration carries dirty-reads.
+            for fn, node, in_init in info.writes:
+                if in_init:
+                    continue
+                held = self._held_locks_map(fn.module, fn).get(
+                    id(node), frozenset()
+                )
+                if decl.lock in held:
+                    continue
+                if held:
+                    self._emit(
+                        report,
+                        fn.module,
+                        "guard-mismatch",
+                        node,
+                        f"write to {key[1]}.{key[2]} holds "
+                        f"{sorted(held)} but the declaration names "
+                        f"{decl.lock}",
+                        fn.qualname,
+                    )
+                else:
+                    self._emit(
+                        report,
+                        fn.module,
+                        "unguarded-shared-write",
+                        node,
+                        f"write to {key[1]}.{key[2]} outside "
+                        f"'with {decl.lock.split('::')[-1]}:' "
+                        f"(declared at {key[0]}:{decl.line})",
+                        fn.qualname,
+                    )
+            if decl.dirty_reads:
+                continue
+            for fn, node in info.reads:
+                held = self._held_locks_map(fn.module, fn).get(
+                    id(node), frozenset()
+                )
+                if decl.lock not in held:
+                    self._emit(
+                        report,
+                        fn.module,
+                        "guard-mismatch",
+                        node,
+                        f"unlocked read of {key[1]}.{key[2]} (guarded-by "
+                        f"{decl.lock.split('::')[-1]}; add a "
+                        "dirty-reads(<reason>) clause if stale reads are "
+                        "safe)",
+                        fn.qualname,
+                    )
+
+    # --------------------------------------------------------- lock ordering
+    def _build_lock_graph(self, report: TraceReport) -> None:
+        # Direct with-nesting edges were recorded by _held_locks_map; force
+        # the map for every function, then add cross-function edges from the
+        # transitive may-acquire sets.
+        for mod in self.modules:
+            for fn in mod.functions:
+                self._held_locks_map(mod, fn)
+        # Transitive acquires to a fixpoint.
+        trans: Dict[int, Set[str]] = {
+            id(fn): set(self._fn_acquires.get(id(fn), set()))
+            for mod in self.modules
+            for fn in mod.functions
+        }
+        fns = [
+            (mod, fn) for mod in self.modules for fn in mod.functions
+        ]
+        changed = True
+        while changed:
+            changed = False
+            for mod, fn in fns:
+                acc = trans[id(fn)]
+                for dotted, _ in fn.calls:
+                    target = self._resolve_call_ext(mod, fn, dotted)
+                    if target is not None and not trans[id(target)] <= acc:
+                        acc |= trans[id(target)]
+                        changed = True
+        self._fn_trans_acquires = trans
+        # Call sites under a held lock acquire everything the callee may.
+        for mod, fn in fns:
+            held_map = self._held_locks_map(mod, fn)
+            for dotted, call in fn.calls:
+                held = held_map.get(id(call), frozenset())
+                if not held:
+                    continue
+                target = self._resolve_call_ext(mod, fn, dotted)
+                if target is None:
+                    continue
+                for inner in trans[id(target)]:
+                    for h in held:
+                        self._add_lock_edge(h, inner, mod, call, fn)
+
+    def _check_lock_cycles(self, report: TraceReport) -> None:
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+        cycles: List[List[str]] = []
+
+        def dfs(node: str) -> None:
+            color[node] = 1
+            stack.append(node)
+            for succ in sorted(self.lock_graph.get(node, ())):
+                if color.get(succ, 0) == 0:
+                    dfs(succ)
+                elif color.get(succ) == 1:
+                    cycle = stack[stack.index(succ):] + [succ]
+                    cycles.append(cycle)
+            stack.pop()
+            color[node] = 2
+
+        for node in sorted(self.lock_graph):
+            if color.get(node, 0) == 0:
+                dfs(node)
+        seen: Set[frozenset] = set()
+        for cycle in cycles:
+            sig = frozenset(cycle)
+            if sig in seen:
+                continue
+            seen.add(sig)
+            report.lock_cycles.append(cycle)
+            a, b = cycle[0], cycle[1]
+            mod, node, qual = self.lock_graph[a][b]
+            self._emit(
+                report,
+                mod,
+                "lock-order-inversion",
+                node,
+                "lock-order cycle: "
+                + " -> ".join(c.split("::")[-1] for c in cycle)
+                + " (two threads can deadlock acquiring these in opposite "
+                "orders)",
+                qual,
+            )
+
+    # ------------------------------------------------------------- hazards
+    def _blocking_call_desc(
+        self, mod: ModuleInfo, fn: FuncInfo, node: ast.Call
+    ) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        meth = node.func.attr
+        key = self._attr_of_target(mod, fn, node.func.value)
+        if key is None:
+            return None
+        info = self.attrs.get(key)
+        if info is None or info.ctor_type is None:
+            return None
+        blocking = R.BLOCKING_METHODS_BY_TYPE.get(info.ctor_type, ())
+        if meth not in blocking:
+            return None
+        # Bounded waits are allowed: any timeout/block=False argument.
+        for kw in node.keywords:
+            if kw.arg in ("timeout",):
+                return None
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant):
+                if kw.value.value is False:
+                    return None
+        if meth == "get" and len(node.args) >= 2:
+            return None
+        if meth == "put" and len(node.args) >= 3:
+            return None
+        if meth in ("join", "wait") and node.args:
+            return None
+        return f"{key[2]}.{meth}()"
+
+    def _check_blocking_and_forks(self, report: TraceReport) -> None:
+        fns = [(mod, fn) for mod in self.modules for fn in mod.functions]
+        # Per-function: the first unconditionally-blocking op description.
+        blocks: Dict[int, Optional[str]] = {}
+        for mod, fn in fns:
+            desc = None
+            for node in _own_walk(fn.node):
+                if isinstance(node, ast.Call):
+                    desc = self._blocking_call_desc(mod, fn, node)
+                    if desc:
+                        break
+            blocks[id(fn)] = desc
+        # Transitive: a call to a may-block function blocks.
+        trans: Dict[int, Optional[str]] = dict(blocks)
+        changed = True
+        while changed:
+            changed = False
+            for mod, fn in fns:
+                if trans[id(fn)]:
+                    continue
+                for dotted, _ in fn.calls:
+                    target = self._resolve_call_ext(mod, fn, dotted)
+                    if target is not None and trans.get(id(target)):
+                        trans[id(fn)] = (
+                            f"{dotted}() -> {trans[id(target)]}"
+                        )
+                        changed = True
+                        break
+        package_spawns_threads = bool(self.roots_found)
+        for mod, fn in fns:
+            held_map = self._held_locks_map(mod, fn)
+            for node in _own_walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = held_map.get(id(node), frozenset())
+                if held:
+                    desc = self._blocking_call_desc(mod, fn, node)
+                    if desc is None:
+                        d = _dotted(node.func)
+                        if d:
+                            target = self._resolve_call_ext(mod, fn, d)
+                            if target is not None and trans.get(id(target)):
+                                desc = f"{d}() -> {trans[id(target)]}"
+                    if desc:
+                        self._emit(
+                            report,
+                            mod,
+                            "blocking-queue-in-lock",
+                            node,
+                            f"unbounded blocking op {desc} while holding "
+                            f"{sorted(h.split('::')[-1] for h in held)}",
+                            fn.qualname,
+                        )
+                canon = mod.canonical(_dotted(node.func)) or ""
+                if canon in R.FORK_CALLS and package_spawns_threads:
+                    self._emit(
+                        report,
+                        mod,
+                        "fork-after-threads",
+                        node,
+                        f"{canon}() in a thread-spawning package — the "
+                        "child inherits held locks and dead threads",
+                        fn.qualname,
+                    )
+                elif canon in R.MP_PROCESS_CALLS and package_spawns_threads:
+                    if not self._spawn_context_visible(mod, fn):
+                        self._emit(
+                            report,
+                            mod,
+                            "fork-after-threads",
+                            node,
+                            f"{canon} without an explicit "
+                            "spawn/forkserver context in a thread-spawning "
+                            "package",
+                            fn.qualname,
+                        )
+
+    @staticmethod
+    def _spawn_context_visible(mod: ModuleInfo, fn: FuncInfo) -> bool:
+        for node in _own_walk(fn.node):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d.endswith("get_context") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and arg.value in (
+                        "spawn",
+                        "forkserver",
+                    ):
+                        return True
+        return False
+
+    def _check_jax_dispatch(self, report: TraceReport) -> None:
+        for mod in self.modules:
+            for fn in mod.functions:
+                bad = fn.roots - R.SANCTIONED_DISPATCH_ROOTS
+                if not bad:
+                    continue
+                for node in _own_walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    canon = mod.canonical(_dotted(node.func)) or ""
+                    if canon in R.JAX_DISPATCH_CALLS or any(
+                        canon.startswith(p) for p in R.JAX_DISPATCH_PREFIXES
+                    ):
+                        self._emit(
+                            report,
+                            mod,
+                            "jax-dispatch-off-main",
+                            node,
+                            f"{canon} dispatches device work from thread "
+                            f"root(s) {sorted(bad)} — only the DeviceFeed "
+                            "transfer stage and the serve dispatcher may "
+                            "touch the device off-main",
+                            fn.qualname,
+                        )
+
+    # ------------------------------------------------------ suppression meta
+    def _check_bare_suppressions(self, report: TraceReport) -> None:
+        """Reason-less / unknown-rule suppressions for the CONCURRENCY rules
+        only (the lint pass owns the check for its own rules; the combined
+        CLI run disables this half to avoid double reports)."""
+        for mod in self.modules:
+            for line, (rule, reason) in sorted(mod.suppressions.items()):
+                if rule not in R.CONCURRENCY_RULES:
+                    continue
+                if not reason:
+                    report.violations.append(
+                        Violation(
+                            rule="suppression-without-reason",
+                            path=mod.relpath,
+                            line=line,
+                            col=0,
+                            message=(
+                                f"disable={rule} needs a justification: "
+                                f"# graftrace: disable={rule}(why this is "
+                                "safe)"
+                            ),
+                            qualname="<module>",
+                        )
+                    )
+
+
+def trace_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    check_suppressions: bool = True,
+) -> TraceReport:
+    """Run graftrace over files/directories; returns the TraceReport
+    (violations exclude properly-suppressed ones, which land in
+    ``report.suppressed``)."""
+    return Tracer(paths, root=root).run(check_suppressions=check_suppressions)

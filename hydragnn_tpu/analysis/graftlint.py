@@ -34,8 +34,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import rules as R
 
+# One suppression grammar for both passes: comments of the form
+# ``graft{lint,race}: disable=<rule>(<why>)`` are interchangeable (the rule
+# id decides which pass it addresses; rules.RULES is the single catalogue).
 _SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*disable=([A-Za-z0-9_-]+)\s*(?:\(([^)]*)\))?"
+    r"#\s*graft(?:lint|race):\s*disable=([A-Za-z0-9_-]+)\s*(?:\(([^)]*)\))?"
 )
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -147,6 +150,9 @@ class FuncInfo:
     class_name: Optional[str]
     traced: bool = False
     calls: List[Tuple[str, ast.Call]] = field(default_factory=list)
+    # graftrace: the set of thread roots this function may execute on
+    # (populated by analysis/concurrency.py, unused by the lint pass).
+    roots: Set[str] = field(default_factory=set)
 
     @property
     def line(self) -> int:
@@ -346,8 +352,9 @@ class Linter:
         return rel.replace("/", ".").removesuffix(".__init__")
 
     # --------------------------------------------------------------- pipeline
-    def run(self) -> Report:
-        report = Report()
+    def load(self, report: Report) -> None:
+        """Parse + index every file (shared with the graftrace pass, which
+        subclasses this linter for the module/callgraph infrastructure)."""
         for path in self.files:
             rel = os.path.relpath(path, self.root).replace(os.sep, "/")
             try:
@@ -368,6 +375,10 @@ class Linter:
             if mod.dotted:
                 self.by_dotted[mod.dotted] = mod
         report.files = len(self.modules)
+
+    def run(self) -> Report:
+        report = Report()
+        self.load(report)
 
         self._mark_traced_roots()
         self._propagate_traced()

@@ -25,6 +25,30 @@ has the full catalogue with examples):
 
 ``suppression-without-reason`` is the meta-rule: every inline
 ``# graftlint: disable=<rule>(<reason>)`` must carry a justification string.
+
+The ``graftrace`` half (analysis/concurrency.py) adds the host-concurrency
+rules over the same catalogue — the five cooperating thread roots
+(prefetch/transfer pipeline, serve batcher+dispatcher+HTTP handlers,
+checkpoint writer, supervisor loop) share counters, caches, and manifests
+that nothing mechanical checked before:
+
+* ``missing-guard-decl``      — an attribute written from >= 2 thread roots
+  carries no ``# guarded-by: <lock>`` declaration.
+* ``unguarded-shared-write``  — a write to a guard-declared attribute outside
+  a ``with <that lock>:`` block (never baselineable: a lost update corrupts
+  counters/caches silently).
+* ``guard-mismatch``          — an access to a guard-declared attribute under
+  a different lock than declared, or an unlocked read without a
+  ``dirty-reads`` clause in the declaration.
+* ``lock-order-inversion``    — the static lock-order graph has a cycle
+  (two threads can acquire the same pair of locks in opposite orders).
+* ``blocking-queue-in-lock``  — an unbounded blocking operation
+  (queue get/put/join, Event.wait, Thread.join) reachable while a lock is
+  held: the classic convoy/deadlock shape.
+* ``fork-after-threads``      — ``os.fork`` / fork-context multiprocessing in
+  a package that starts threads (a forked child inherits locked locks).
+* ``jax-dispatch-off-main``   — JAX dispatch from a thread root outside the
+  sanctioned DeviceFeed transfer / serve dispatch paths.
 """
 
 from __future__ import annotations
@@ -71,8 +95,57 @@ RULES = {
             "suppression-without-reason",
             "graftlint suppression comment without a justification string",
         ),
+        # ------------------------------------------------ graftrace (concurrency)
+        Rule(
+            "missing-guard-decl",
+            "attribute written from >= 2 thread roots without a "
+            "'# guarded-by: <lock>' declaration",
+        ),
+        Rule(
+            "unguarded-shared-write",
+            "write to a guard-declared shared attribute outside a "
+            "'with <declared lock>:' block",
+        ),
+        Rule(
+            "guard-mismatch",
+            "access to a guard-declared attribute under the wrong lock, or "
+            "an unlocked read without a dirty-reads clause",
+        ),
+        Rule(
+            "lock-order-inversion",
+            "cycle in the static lock-order graph (potential deadlock)",
+        ),
+        Rule(
+            "blocking-queue-in-lock",
+            "unbounded blocking operation (queue get/put/join, Event.wait, "
+            "Thread.join) reachable while holding a lock",
+        ),
+        Rule(
+            "fork-after-threads",
+            "os.fork / fork-context multiprocessing in a thread-spawning "
+            "package (forked children inherit held locks)",
+        ),
+        Rule(
+            "jax-dispatch-off-main",
+            "JAX dispatch from a thread root outside the sanctioned "
+            "DeviceFeed transfer / serve dispatch paths",
+        ),
     )
 }
+
+# Rule ids owned by the graftrace concurrency pass (analysis/concurrency.py);
+# everything else in RULES is the graftlint pass's.
+CONCURRENCY_RULES = frozenset(
+    {
+        "missing-guard-decl",
+        "unguarded-shared-write",
+        "guard-mismatch",
+        "lock-order-inversion",
+        "blocking-queue-in-lock",
+        "fork-after-threads",
+        "jax-dispatch-off-main",
+    }
+)
 
 
 # --------------------------------------------------------------- framework map
@@ -166,4 +239,101 @@ HOST_SYNC_BUILTINS = frozenset({"float", "int", "bool"})
 # np.random attributes that are fine (explicitly-seeded generator plumbing).
 SEEDED_NP_RANDOM = frozenset(
     {"default_rng", "Generator", "SeedSequence", "PCG64", "Philox"}
+)
+
+
+# ----------------------------------------------------- graftrace framework map
+# The implicit main thread every entry point runs on.
+MAIN_THREAD_ROOT = "main"
+
+# Framework callables whose callable/iterable ARGUMENTS run on pipeline
+# threads even though no ``threading.Thread(target=...)`` is visible at the
+# call site (train/pipeline.py's two-stage feed): position/keyword -> the
+# thread root the bound callable executes on. The same blindness
+# TRACED_FACTORIES fixes for tracedness, fixed for runs-on-thread.
+THREAD_CALLABLE_BINDINGS = {
+    "DeviceFeed": {0: "feed-host", "iterable": "feed-host",
+                   1: "feed-transfer", "transfer": "feed-transfer"},
+    "_Prefetcher": {0: "feed-host", "iterable": "feed-host"},
+}
+
+# Factories whose NESTED function definitions run on a pipeline thread (the
+# returned closure is installed as a DeviceFeed transfer stage; static
+# analysis cannot see through the return, exactly like TRACED_FACTORIES).
+THREAD_FACTORY_ROOTS = {
+    "with_transfer_retries": "feed-transfer",
+}
+
+# Classes whose subclasses' methods run on per-connection server threads.
+HTTP_HANDLER_BASES = frozenset({"BaseHTTPRequestHandler"})
+HTTP_HANDLER_ROOT = "http-handler"
+
+# Thread roots allowed to dispatch JAX work. Everything host-side must stay
+# jax-free: the checkpoint writer thread serializes already-snapshotted host
+# numpy, the batcher collates with numpy, HTTP handlers only block on
+# futures. The transfer stage and the serve dispatcher ARE the sanctioned
+# device paths (docs/INPUT_PIPELINE.md, docs/SERVING.md).
+SANCTIONED_DISPATCH_ROOTS = frozenset(
+    {MAIN_THREAD_ROOT, "feed-transfer", "hydragnn-serve-dispatch"}
+)
+
+# Dotted call prefixes that dispatch device work when executed.
+JAX_DISPATCH_CALLS = frozenset(
+    {
+        "jax.device_put",
+        "jax.device_get",
+        "jax.block_until_ready",
+        "jax.jit",
+        "jax.pmap",
+        "jax.eval_shape",
+    }
+)
+JAX_DISPATCH_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.")
+
+# Attribute types that synchronize themselves — writes THROUGH them need no
+# guard (the binding write of the attribute cell itself still does, when it
+# happens outside __init__).
+THREAD_SAFE_TYPES = frozenset(
+    {
+        "queue.Queue",
+        "queue.SimpleQueue",
+        "queue.LifoQueue",
+        "queue.PriorityQueue",
+        "threading.Lock",
+        "threading.RLock",
+        "threading.Condition",
+        "threading.Event",
+        "threading.Semaphore",
+        "threading.BoundedSemaphore",
+        "threading.local",
+        "collections.deque",
+    }
+)
+
+# Container-mutator method names: ``self.X.append(...)`` mutates X.
+MUTATOR_METHODS = frozenset(
+    {
+        "append", "extend", "insert", "add", "discard", "remove", "pop",
+        "popitem", "clear", "update", "setdefault", "sort", "reverse",
+    }
+)
+
+# Unbounded blocking calls by receiver type (graftrace types attributes from
+# their __init__ construction): method names that park the calling thread.
+BLOCKING_METHODS_BY_TYPE = {
+    "queue.Queue": ("put", "get", "join"),
+    "queue.LifoQueue": ("put", "get", "join"),
+    "queue.PriorityQueue": ("put", "get", "join"),
+    "queue.SimpleQueue": ("put", "get"),
+    "threading.Event": ("wait",),
+    "threading.Condition": ("wait", "wait_for"),
+    "threading.Thread": ("join",),
+}
+
+# Process-fork entry points (fork-after-threads). subprocess.* is fork+exec
+# and safe; multiprocessing with an explicit "spawn"/"forkserver" context is
+# exempted at the call site.
+FORK_CALLS = frozenset({"os.fork", "os.forkpty", "pty.fork"})
+MP_PROCESS_CALLS = frozenset(
+    {"multiprocessing.Process", "multiprocessing.Pool"}
 )

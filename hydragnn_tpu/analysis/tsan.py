@@ -1,0 +1,302 @@
+"""Runtime thread-sanitizer half of graftrace (docs/STATIC_ANALYSIS.md
+"graftrace: the runtime half").
+
+Opt-in (``HYDRAGNN_TSAN=1`` or :func:`enable`) instrumentation that wraps
+the concurrency layer's REGISTERED locks and records, during fault drills
+and tests:
+
+* **actual lock-acquisition orders** — every ``A held while acquiring B``
+  becomes a dynamic edge; an observed ``B -> A`` after ``A -> B`` is a
+  dynamic lock-order inversion (the runtime witness of the static
+  ``lock-order-inversion`` rule), recorded with both thread names;
+* **cross-thread shared accesses** — code paths the static pass guards call
+  :func:`shared_access` (inside their lock) with a site name; an access
+  observed from >= 2 threads where some pair of observations shares NO
+  common held lock is an *unregistered cross-thread access* (the runtime
+  witness of ``unguarded-shared-write``);
+* **seeded yield-point schedule fuzzing** — :func:`yield_point` sites
+  perturb thread interleavings with tiny sleeps decided by a per-site
+  deterministic PRNG stream (seed x site-name x visit-count), so a drill
+  that exposes a race under seed S exposes it under seed S every time.
+
+Zero cost when disabled: ``instrument_lock`` returns the lock unchanged and
+``shared_access``/``yield_point`` return after one module-bool check — the
+serve hot path stays uninstrumented unless an operator asks.
+
+:func:`cross_check` merges the dynamic edges into the static lock-order
+graph (analysis/concurrency.py ``TraceReport.lock_edges``) and reports any
+cycle the union introduces: a dynamic order the static model missed, or a
+static order production contradicts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+_ENV_FLAG = "HYDRAGNN_TSAN"
+_ENV_SEED = "HYDRAGNN_TSAN_SEED"
+
+_enabled = os.environ.get(_ENV_FLAG, "") == "1"
+_seed = int(os.environ.get(_ENV_SEED, "0") or 0)
+
+_registry_lock = threading.Lock()
+_held = threading.local()  # per-thread stack of held instrumented-lock names
+
+# The registry is the one object the sanitizer itself must keep consistent —
+# graftrace checks these declarations like any other module's (dogfood).
+_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}  # guarded-by: _registry_lock
+_inversions: List[Dict[str, str]] = []  # guarded-by: _registry_lock
+_accesses: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}  # guarded-by: _registry_lock
+_unregistered: List[Dict[str, str]] = []  # guarded-by: _registry_lock
+_yield_counts: Dict[str, int] = {}  # guarded-by: _registry_lock
+_yield_schedule: Dict[str, List[int]] = {}  # guarded-by: _registry_lock
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(seed: int = 0) -> None:
+    """Turn instrumentation on for locks created AFTER this call (tests and
+    drills call this before constructing the engine/checkpointer)."""
+    global _enabled, _seed
+    _enabled = True
+    _seed = int(seed)
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Clear every recorded fact (the enable flag and seed persist)."""
+    with _registry_lock:
+        _edges.clear()
+        _inversions.clear()
+        _accesses.clear()
+        _unregistered.clear()
+        _yield_counts.clear()
+        _yield_schedule.clear()
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+class TsanLock:
+    """Lock proxy recording acquisition order. Supports the ``with`` protocol
+    plus acquire/release/locked, so it drops in for ``threading.Lock``."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock, name: str):
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._on_acquire()
+        return got
+
+    def release(self) -> None:
+        self._on_release()
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self._lock.acquire()
+        self._on_acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._on_release()
+        self._lock.release()
+
+    # ------------------------------------------------------------- recording
+    def _on_acquire(self) -> None:
+        stack = _held_stack()
+        if stack:
+            thread = threading.current_thread().name
+            with _registry_lock:
+                for h in stack:
+                    if h == self.name:
+                        continue
+                    key = (h, self.name)
+                    prev = _edges.get(key)
+                    _edges[key] = (thread, (prev[1] if prev else 0) + 1)
+                    rev = _edges.get((self.name, h))
+                    if rev is not None:
+                        _inversions.append(
+                            {
+                                "first": f"{h} -> {self.name}",
+                                "first_thread": thread,
+                                "second": f"{self.name} -> {h}",
+                                "second_thread": rev[0],
+                            }
+                        )
+        stack.append(self.name)
+
+    def _on_release(self) -> None:
+        stack = _held_stack()
+        if self.name in stack:
+            # Remove the most recent acquisition (non-LIFO release legal).
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+
+
+def instrument_lock(lock, name: str):
+    """Wrap ``lock`` for order recording when the sanitizer is enabled;
+    return it unchanged (zero overhead) when not."""
+    if not _enabled:
+        return lock
+    return TsanLock(lock, name)
+
+
+def shared_access(site: str) -> None:
+    """Record one access to a registered shared-state site from the current
+    thread with the currently-held instrumented locks. Call INSIDE the
+    guarding lock — a site observed from two threads with no common held
+    lock is an unregistered cross-thread access."""
+    if not _enabled:
+        return
+    thread = threading.current_thread().name
+    locks = frozenset(_held_stack())
+    with _registry_lock:
+        seen = _accesses.setdefault(site, [])
+        for other_thread, other_locks in seen:
+            if other_thread != thread and not (locks & other_locks):
+                _unregistered.append(
+                    {
+                        "site": site,
+                        "thread_a": other_thread,
+                        "locks_a": ",".join(sorted(other_locks)) or "<none>",
+                        "thread_b": thread,
+                        "locks_b": ",".join(sorted(locks)) or "<none>",
+                    }
+                )
+                break
+        # Bound the per-site memory: distinct (thread, locks) shapes only.
+        if (thread, locks) not in seen:
+            seen.append((thread, locks))
+
+
+def yield_point(site: str) -> None:
+    """Annotated interleaving site: under a seeded schedule, deterministically
+    decide (per site visit) whether to yield the GIL / sleep briefly, so
+    thread interleavings are perturbed the same way for the same seed."""
+    if not _enabled:
+        return
+    # Visit allocation, decision, and schedule append are ONE critical
+    # section: split in two, concurrent visitors could append out of visit
+    # order and the recorded schedule would be interleaving-dependent —
+    # the exact nondeterminism this module exists to remove.
+    with _registry_lock:
+        n = _yield_counts.get(site, 0)
+        _yield_counts[site] = n + 1
+        decision = _decide(site, n)
+        _yield_schedule.setdefault(site, []).append(decision)
+    if decision == 1:
+        time.sleep(0)  # release the GIL, stay on the runqueue
+    elif decision == 2:
+        time.sleep(0.0005)  # force a reschedule window
+
+
+def _decide(site: str, visit: int) -> int:
+    """Deterministic per-(seed, site, visit) decision in {0, 1, 2} — a hash
+    stream, so a site's schedule never depends on OTHER threads' progress
+    (the property that makes a seeded repro a repro)."""
+    h = hashlib.sha256(f"{_seed}:{site}:{visit}".encode()).digest()
+    return h[0] % 3
+
+
+def schedule(site: Optional[str] = None):
+    """The recorded yield decisions (per site, in visit order) — the
+    determinism witness tests compare across runs."""
+    with _registry_lock:
+        if site is not None:
+            return list(_yield_schedule.get(site, []))
+        return {k: list(v) for k, v in _yield_schedule.items()}
+
+
+def report() -> Dict:
+    """Everything recorded since the last reset, JSON-shaped."""
+    with _registry_lock:
+        return {
+            "enabled": _enabled,
+            "seed": _seed,
+            "lock_edges": sorted(
+                f"{a} -> {b}" for (a, b) in _edges
+            ),
+            "dynamic_inversions": list(_inversions),
+            "shared_sites": {
+                site: sorted({t for t, _ in obs})
+                for site, obs in _accesses.items()
+            },
+            "unregistered_cross_thread": list(_unregistered),
+            "yield_counts": dict(_yield_counts),
+        }
+
+
+def dynamic_edges() -> List[Tuple[str, str]]:
+    with _registry_lock:
+        return sorted(_edges)
+
+
+def cross_check(static_edges: Sequence[Tuple[str, str]]) -> Dict:
+    """Merge the dynamic acquisition orders into the static lock-order graph
+    and look for cycles in the union. ``static_edges`` come from
+    ``TraceReport.lock_edges`` — lock ids there are ``path::Class.attr``;
+    dynamic names are the ``instrument_lock`` registration names
+    (``Class.attr``), so both sides are compared on their ``Class.attr``
+    tails."""
+
+    def tail(lock: str) -> str:
+        return lock.split("::")[-1]
+
+    graph: Dict[str, Set[str]] = {}
+    for a, b in static_edges:
+        graph.setdefault(tail(a), set()).add(tail(b))
+        graph.setdefault(tail(b), set())
+    for a, b in dynamic_edges():
+        graph.setdefault(tail(a), set()).add(tail(b))
+        graph.setdefault(tail(b), set())
+
+    cycles: List[List[str]] = []
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(node: str) -> None:
+        color[node] = 1
+        stack.append(node)
+        for succ in sorted(graph.get(node, ())):
+            if color.get(succ, 0) == 0:
+                dfs(succ)
+            elif color.get(succ) == 1:
+                cycles.append(stack[stack.index(succ):] + [succ])
+        stack.pop()
+        color[node] = 2
+
+    for node in sorted(graph):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    with _registry_lock:
+        dynamic_findings = bool(_inversions or _unregistered)
+    return {
+        "static_edges": len(static_edges),
+        "dynamic_edges": len(dynamic_edges()),
+        "merged_cycles": cycles,
+        "ok": not cycles and not dynamic_findings,
+    }

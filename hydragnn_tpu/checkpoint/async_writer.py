@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..analysis import tsan
 from . import io as ckpt_io
 
 
@@ -42,9 +43,11 @@ class AsyncCheckpointer:
 
     def __init__(self, max_inflight: int = 1):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, int(max_inflight)))
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "AsyncCheckpointer._lock"
+        )
         self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
-        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None  # guarded-by: self._lock
         self._closed = False
 
     # ------------------------------------------------------------- internals
@@ -62,16 +65,19 @@ class AsyncCheckpointer:
                 self._queue.task_done()
                 return
             try:
+                tsan.yield_point("ckpt.worker.pre_save")
                 ckpt_io.save_model(**job)
             except BaseException as e:  # re-raised on the training thread
                 with self._lock:
                     self._error = e
+                    tsan.shared_access("AsyncCheckpointer.error")
             finally:
                 self._queue.task_done()
 
     def _raise_pending(self) -> None:
         with self._lock:
             err, self._error = self._error, None
+            tsan.shared_access("AsyncCheckpointer.error")
         if err is not None:
             raise RuntimeError(
                 "async checkpoint writer failed; the last checkpoint was NOT "
@@ -113,6 +119,7 @@ class AsyncCheckpointer:
             "keep_last_k": keep_last_k,
         }
         self._ensure_thread()
+        tsan.yield_point("ckpt.save.pre_enqueue")
         self._queue.put(job)
         from ..faults import FaultCounters
 

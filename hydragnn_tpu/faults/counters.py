@@ -17,12 +17,14 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
+from ..analysis import tsan
+
 
 class FaultCounters:
     """Accumulating named integer counters; class-level registry."""
 
-    _counts: Dict[str, int] = {}
-    _lock = threading.Lock()
+    _counts: Dict[str, int] = {}  # guarded-by: FaultCounters._lock
+    _lock = tsan.instrument_lock(threading.Lock(), "FaultCounters._lock")
 
     @classmethod
     def inc(cls, name: str, n: int = 1) -> None:
@@ -30,6 +32,7 @@ class FaultCounters:
             return
         with cls._lock:
             cls._counts[name] = cls._counts.get(name, 0) + int(n)
+            tsan.shared_access("FaultCounters.registry")
 
     @classmethod
     def get(cls, name: str) -> int:

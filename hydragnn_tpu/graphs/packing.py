@@ -150,8 +150,10 @@ class SizeHistogram:
     """
 
     def __init__(self):
-        self.graphs: Dict[Tuple[int, int], int] = {}
-        self.batches: Dict[Tuple[int, int, int], int] = {}
+        # Single-threaded on the training path (loader-owned); under serving
+        # the owning ServeMetrics records into it holding ITS lock.
+        self.graphs: Dict[Tuple[int, int], int] = {}  # guarded-by: external(callers synchronize; ServeMetrics records under ServeMetrics._lock, the training loader is single-threaded)
+        self.batches: Dict[Tuple[int, int, int], int] = {}  # guarded-by: external(callers synchronize; ServeMetrics records under ServeMetrics._lock, the training loader is single-threaded)
 
     def record_graph(self, nodes: int, edges: int, weight: int = 1) -> None:
         key = (int(nodes), int(edges))

@@ -149,7 +149,7 @@ def main(argv=None) -> int:
     )
     print(
         f"hydragnn_tpu.serve listening on http://{server.host}:{server.port} "
-        f"(buckets compiled: {len(engine._executables)})",
+        f"(buckets compiled: {engine.compiled_buckets})",
         flush=True,
     )
     try:

@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import tsan
 from ..graphs.collate import GraphArena, round_up_pow2
 from ..graphs.packing import PackCaps, first_fit_decreasing
 from ..graphs.sample import GraphSample
@@ -244,18 +245,24 @@ class InferenceEngine:
                 model, params, bstats, batch, train=False
             )
         )
-        self._executables: Dict[Tuple[int, int, int], Any] = {}
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "InferenceEngine._lock"
+        )
+        # Compiled-executable cache: filled by warmup() on the caller thread
+        # AND by cache misses on the dispatch thread. Lookups/stores hold the
+        # lock; the compile itself runs outside it (a 10-50 s lowering must
+        # not block submit()'s pending-set bookkeeping).
+        self._executables: Dict[Tuple[int, int, int], Any] = {}  # guarded-by: self._lock
 
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_limit)
-        self._pending: set = set()
-        self._lock = threading.Lock()
+        self._pending: set = set()  # guarded-by: self._lock
         self._closing = threading.Event()
-        self._error: Optional[BaseException] = None
-        self._feed: Optional[DeviceFeed] = None
-        self._dispatcher: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None  # guarded-by: self._lock, dirty-reads(set at most once before _closing; the submit fast path may read one poison late and is re-checked post-enqueue)
+        self._feed: Optional[DeviceFeed] = None  # guarded-by: self._lock, dirty-reads(rebound only by start/_fail, serialized by the _closing/_gen_stop protocol; close() joins a possibly-stale feed harmlessly)
+        self._dispatcher: Optional[threading.Thread] = None  # guarded-by: self._lock, dirty-reads(same lifecycle protocol as _feed)
         self._guard_outputs = bool(guard_outputs)
-        self._restarts_left = int(max_worker_restarts)
-        self._degraded = False
+        self._restarts_left = int(max_worker_restarts)  # guarded-by: self._lock, dirty-reads(decremented only by _fail on the dispatch thread; budget off-by-one under a torn restart is acceptable degradation)
+        self._degraded = False  # guarded-by: self._lock, dirty-reads(sticky monotonic bool; a stale False read only delays the /healthz downgrade by one scrape)
         # Per-incarnation stop flag for the batcher generator: on a worker
         # restart the OLD batcher must stop consuming the shared request
         # queue before the new one starts (two live batchers would race).
@@ -272,16 +279,19 @@ class InferenceEngine:
         if self._dispatcher is not None:
             return
         self._gen_stop = threading.Event()
-        self._feed = DeviceFeed(
+        feed = DeviceFeed(
             self._batch_source(self._gen_stop),
             transfer=self._transfer,
             host_depth=2,
         )
-        self._dispatcher = threading.Thread(
+        dispatcher = threading.Thread(
             target=self._dispatch_loop, name="hydragnn-serve-dispatch",
             daemon=True,
         )
-        self._dispatcher.start()
+        with self._lock:
+            self._feed = feed
+            self._dispatcher = dispatcher
+        dispatcher.start()
 
     @property
     def running(self) -> bool:
@@ -291,6 +301,14 @@ class InferenceEngine:
             and self._error is None
             and not self._closing.is_set()
         )
+
+    @property
+    def compiled_buckets(self) -> int:
+        """Locked executable-cache size — /healthz and the serve CLI read
+        this cross-thread (graftrace's read check stops at ``self.X`` forms;
+        callers must not reach through ``engine._executables`` directly)."""
+        with self._lock:
+            return len(self._executables)
 
     @property
     def degraded(self) -> bool:
@@ -347,6 +365,10 @@ class InferenceEngine:
         req = _Request(sample=sample, future=_Future(), t_submit=time.perf_counter())
         with self._lock:
             self._pending.add(req.future)
+        # Annotated interleaving site: the window between pending-set entry
+        # and enqueue is where a concurrent _fail must not strand the future
+        # (tsan's seeded schedule fuzzing widens it deterministically).
+        tsan.yield_point("serve.submit.pre_enqueue")
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -525,7 +547,8 @@ class InferenceEngine:
                         self._reject(req, e)
                     self.metrics.count("errors_total")
                     self.metrics.count("bad_batches_total")
-                    self._degraded = True
+                    with self._lock:
+                        self._degraded = True
                     continue
                 yield work
             if saw_shutdown:
@@ -618,12 +641,16 @@ class InferenceEngine:
             dev_batch.num_edges_pad,
             dev_batch.num_graphs_pad,
         )
-        exe = self._executables.get(key)
+        with self._lock:
+            exe = self._executables.get(key)
         if exe is None:
+            # Compile OUTSIDE the lock: a 10-50 s lowering must not block
+            # submit()'s pending-set bookkeeping or /healthz reads.
             t0 = time.perf_counter()
             exe = self._jit.lower(self._params, self._bstats, dev_batch).compile()
             self.metrics.record_compile(time.perf_counter() - t0)
-            self._executables[key] = exe
+            with self._lock:
+                self._executables[key] = exe
         else:
             self.metrics.count("cache_hits_total")
         return exe
@@ -657,6 +684,7 @@ class InferenceEngine:
             # The batcher's shutdown marker ends the feed iteration; every
             # batch flushed before it is still executed and resolved here.
             for work, dev_batch in self._feed:
+                tsan.yield_point("serve.dispatch.pre_execute")
                 # _execute failures (compile, device runtime) fall through to
                 # _fail: the device's health is engine-scoped. Resolution
                 # failures (per-request slicing/denormalization) are
@@ -669,7 +697,8 @@ class InferenceEngine:
                         self._reject(req, e)
                     self.metrics.count("errors_total")
                     self.metrics.count("bad_batches_total")
-                    self._degraded = True
+                    with self._lock:
+                        self._degraded = True
         except BaseException as e:  # noqa: BLE001 — re-raised at callers
             self._fail(e)
 
@@ -706,7 +735,8 @@ class InferenceEngine:
             self.metrics.observe("e2e", now - req.t_submit)
         if batch_had_nonfinite:
             self.metrics.count("bad_batches_total")
-            self._degraded = True
+            with self._lock:
+                self._degraded = True
 
     def _denormalize(self, ihead: int, value: np.ndarray) -> np.ndarray:
         if self._y_minmax is None:
@@ -743,7 +773,8 @@ class InferenceEngine:
         if not restartable:
             # Poison FIRST so concurrent submits fail fast (their post-
             # enqueue re-check sees the error) before the queue drain below.
-            self._error = exc
+            with self._lock:
+                self._error = exc
             self._closing.set()
         # Tear down this incarnation's pipeline either way: stop the batcher
         # FIRST (a stale batcher racing a successor on the shared queue would
@@ -766,11 +797,12 @@ class InferenceEngine:
                 self._reject(req, exc)
         self._fail_pending(exc)
         if restartable:
-            self._restarts_left -= 1
-            self._degraded = True
+            with self._lock:
+                self._restarts_left -= 1
+                self._degraded = True
+                self._feed = None
+                self._dispatcher = None
             self.metrics.count("engine_restarts_total")
-            self._feed = None
-            self._dispatcher = None
             self.start()
 
     # -------------------------------------------------------------- warmup
@@ -789,13 +821,16 @@ class InferenceEngine:
         # at this point must warm too, as the docstring promises.
         for n_pad, e_pad in self._ladder:
             key = (int(n_pad), int(e_pad), self._g_pad)
-            if key in self._executables:
+            with self._lock:
+                warm = key in self._executables
+            if warm:
                 continue
             batch = self._dummy_batch(int(n_pad), int(e_pad))
             t0 = time.perf_counter()
             exe = self._jit.lower(self._params, self._bstats, batch).compile()
             self.metrics.record_compile(time.perf_counter() - t0)
-            self._executables[key] = exe
+            with self._lock:
+                self._executables[key] = exe
             compiled += 1
         return compiled
 

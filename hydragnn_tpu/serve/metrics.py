@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..analysis import tsan
 from ..graphs.packing import SizeHistogram
 from ..utils.time_utils import Timer
 
@@ -33,10 +34,12 @@ class LatencyHistogram:
 
     def __init__(self, bounds: Sequence[float] = _DEFAULT_BOUNDS):
         self.bounds = tuple(float(b) for b in bounds)
-        self._counts = [0] * (len(self.bounds) + 1)  # +inf overflow bucket
-        self.count = 0
-        self.sum = 0.0
-        self._lock = threading.Lock()
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "LatencyHistogram._lock"
+        )
+        self._counts = [0] * (len(self.bounds) + 1)  # guarded-by: self._lock
+        self.count = 0  # guarded-by: self._lock
+        self.sum = 0.0  # guarded-by: self._lock
 
     def observe(self, seconds: float) -> None:
         seconds = float(seconds)
@@ -119,39 +122,46 @@ class ServeMetrics:
     _STAGES = ("queue_wait", "collate", "h2d", "device", "e2e")
 
     def __init__(self):
-        self.latency = {s: LatencyHistogram() for s in self._STAGES}
-        self._lock = threading.Lock()
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "ServeMetrics._lock"
+        )
+        # Observations arrive from the batcher (feed-host), transfer,
+        # dispatch, and caller threads; every field below is declared
+        # guarded (graftrace enforces the with-blocks mechanically).
+        self.latency = {  # guarded-by: self._lock, dirty-reads(dict is immutable after construction; the leaf histograms carry their own lock)
+            s: LatencyHistogram() for s in self._STAGES
+        }
         # Counters (monotonic).
-        self.requests_total = 0
-        self.rejected_total = 0
-        self.errors_total = 0
+        self.requests_total = 0  # guarded-by: self._lock
+        self.rejected_total = 0  # guarded-by: self._lock
+        self.errors_total = 0  # guarded-by: self._lock
         # Fault-tolerance split of errors (docs/FAULT_TOLERANCE.md):
         # batch-scoped failures keep the engine serving; worker restarts
         # consume the engine's restart budget; non-finite outputs fail the
         # REQUEST, not the engine.
-        self.bad_batches_total = 0
-        self.nonfinite_total = 0
-        self.engine_restarts_total = 0
-        self.batches_total = 0
-        self.graphs_total = 0
-        self.cache_hits_total = 0
-        self.cache_misses_total = 0
-        self.ladder_fallback_total = 0  # batches whose shape missed the ladder
-        self.compile_seconds_total = 0.0
-        self.h2d_bytes_total = 0
+        self.bad_batches_total = 0  # guarded-by: self._lock
+        self.nonfinite_total = 0  # guarded-by: self._lock
+        self.engine_restarts_total = 0  # guarded-by: self._lock
+        self.batches_total = 0  # guarded-by: self._lock
+        self.graphs_total = 0  # guarded-by: self._lock
+        self.cache_hits_total = 0  # guarded-by: self._lock
+        self.cache_misses_total = 0  # guarded-by: self._lock
+        self.ladder_fallback_total = 0  # guarded-by: self._lock
+        self.compile_seconds_total = 0.0  # guarded-by: self._lock
+        self.h2d_bytes_total = 0  # guarded-by: self._lock
         # Occupancy / padding accumulators (averages derived in snapshot()).
-        self._occupancy_sum = 0.0
-        self._node_fill_sum = 0.0
-        self._edge_fill_sum = 0.0
+        self._occupancy_sum = 0.0  # guarded-by: self._lock
+        self._node_fill_sum = 0.0  # guarded-by: self._lock
+        self._edge_fill_sum = 0.0  # guarded-by: self._lock
         # Per-bucket occupancy: the same accumulators keyed by the padded
         # (N_pad, E_pad) shape the batch compiled into, so a ladder's rungs
         # are individually observable (which rungs carry traffic, which
         # waste it) — docs/SERVING.md "Metrics reference".
-        self._per_bucket: Dict[Tuple[int, int], Dict[str, float]] = {}
+        self._per_bucket: Dict[Tuple[int, int], Dict[str, float]] = {}  # guarded-by: self._lock
         # Observed request/batch sizes: the feedback record the ladder
         # fitter consumes (graphs/packing.py fit_ladder; dump via
         # histogram_json()). Guarded by the same lock as the counters.
-        self.size_hist = SizeHistogram()
+        self.size_hist = SizeHistogram()  # guarded-by: self._lock
 
     # ------------------------------------------------------------- recorders
     def observe(self, stage: str, seconds: float) -> None:
@@ -161,6 +171,14 @@ class ServeMetrics:
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + n)
+            tsan.shared_access("ServeMetrics.counters")
+
+    def read_counters(self, *names: str) -> Dict[str, float]:
+        """One locked copy of the named counters — cross-thread readers
+        (/healthz) must not assemble their view field-by-field between the
+        recorder's updates (torn pairs; same defect render_prometheus had)."""
+        with self._lock:
+            return {n: getattr(self, n) for n in names}
 
     def record_compile(self, seconds: float) -> None:
         with self._lock:
@@ -184,6 +202,7 @@ class ServeMetrics:
         e_pad: int,
     ) -> None:
         with self._lock:
+            tsan.shared_access("ServeMetrics.counters")
             self.batches_total += 1
             self.graphs_total += num_graphs
             self._occupancy_sum += num_graphs / max(max_batch_graphs, 1)
@@ -262,37 +281,37 @@ class ServeMetrics:
         with self._lock:
             return self.size_hist.to_json()
 
+    # Counter attr -> exported Prometheus metric name. Exposition reads the
+    # whole set in ONE locked copy — graftrace flagged the original
+    # field-by-field unlocked reads (a scrape mid-record saw torn pairs,
+    # e.g. batches_total incremented but graphs_total not yet).
+    _PROM_COUNTERS = (
+        ("requests_total", "requests_total"),
+        ("rejected_total", "rejected_total"),
+        ("errors_total", "errors_total"),
+        ("bad_batches_total", "bad_batches_total"),
+        ("nonfinite_total", "nonfinite_total"),
+        ("engine_restarts_total", "engine_restarts_total"),
+        ("batches_total", "batches_total"),
+        ("graphs_total", "graphs_total"),
+        ("cache_hits_total", "bucket_cache_hits_total"),
+        ("cache_misses_total", "bucket_cache_misses_total"),
+        ("ladder_fallback_total", "ladder_fallback_total"),
+        ("compile_seconds_total", "compile_seconds_total"),
+        ("h2d_bytes_total", "h2d_bytes_total"),
+    )
+
     def render_prometheus(self) -> str:
         """Prometheus text-format exposition (the /metrics payload)."""
         p = "hydragnn_serve"
-        lines = [
-            f"# TYPE {p}_requests_total counter",
-            f"{p}_requests_total {self.requests_total}",
-            f"# TYPE {p}_rejected_total counter",
-            f"{p}_rejected_total {self.rejected_total}",
-            f"# TYPE {p}_errors_total counter",
-            f"{p}_errors_total {self.errors_total}",
-            f"# TYPE {p}_bad_batches_total counter",
-            f"{p}_bad_batches_total {self.bad_batches_total}",
-            f"# TYPE {p}_nonfinite_total counter",
-            f"{p}_nonfinite_total {self.nonfinite_total}",
-            f"# TYPE {p}_engine_restarts_total counter",
-            f"{p}_engine_restarts_total {self.engine_restarts_total}",
-            f"# TYPE {p}_batches_total counter",
-            f"{p}_batches_total {self.batches_total}",
-            f"# TYPE {p}_graphs_total counter",
-            f"{p}_graphs_total {self.graphs_total}",
-            f"# TYPE {p}_bucket_cache_hits_total counter",
-            f"{p}_bucket_cache_hits_total {self.cache_hits_total}",
-            f"# TYPE {p}_bucket_cache_misses_total counter",
-            f"{p}_bucket_cache_misses_total {self.cache_misses_total}",
-            f"# TYPE {p}_ladder_fallback_total counter",
-            f"{p}_ladder_fallback_total {self.ladder_fallback_total}",
-            f"# TYPE {p}_compile_seconds_total counter",
-            f"{p}_compile_seconds_total {self.compile_seconds_total}",
-            f"# TYPE {p}_h2d_bytes_total counter",
-            f"{p}_h2d_bytes_total {self.h2d_bytes_total}",
-        ]
+        with self._lock:
+            counters = {
+                attr: getattr(self, attr) for attr, _ in self._PROM_COUNTERS
+            }
+        lines = []
+        for attr, metric in self._PROM_COUNTERS:
+            lines.append(f"# TYPE {p}_{metric} counter")
+            lines.append(f"{p}_{metric} {counters[attr]}")
         snap = self.snapshot()
         for gauge in (
             "batch_occupancy_mean",

@@ -94,6 +94,9 @@ class _Handler(BaseHTTPRequestHandler):
             # Three health states instead of the old binary: ok (200),
             # degraded-but-serving (200, degraded: true — bad batches,
             # non-finite outputs, or a worker restart happened), down (503).
+            fault_counters = engine.metrics.read_counters(
+                "bad_batches_total", "nonfinite_total", "engine_restarts_total"
+            )
             self._send_json(
                 200 if engine.running else 503,
                 {
@@ -101,10 +104,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "degraded": engine.degraded,
                     "queue_depth": engine._queue.qsize(),
                     "queue_limit": engine.queue_limit,
-                    "compiled_buckets": len(engine._executables),
-                    "bad_batches": engine.metrics.bad_batches_total,
-                    "nonfinite_outputs": engine.metrics.nonfinite_total,
-                    "restarts": engine.metrics.engine_restarts_total,
+                    "compiled_buckets": engine.compiled_buckets,
+                    "bad_batches": fault_counters["bad_batches_total"],
+                    "nonfinite_outputs": fault_counters["nonfinite_total"],
+                    "restarts": fault_counters["engine_restarts_total"],
                 },
             )
         elif self.path == "/metrics":

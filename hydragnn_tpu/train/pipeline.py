@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from ..analysis import tsan
+
 
 def transfer_error_is_transient(e: BaseException) -> bool:
     """Transfer failures worth retrying: runtime transport flaps (the tunnel's
@@ -167,10 +169,13 @@ class _Prefetcher:
 class FeedStats:
     """Per-epoch transfer-vs-compute split of one epoch-level driver call.
 
-    Written from two threads without a lock, by design: the transfer thread
-    owns the ``h2d_*`` fields, the consumer owns ``feed_wait_s``/``step_s``
-    (disjoint fields, and the consumer only reads the totals after the
-    pipeline drained).
+    Written from two threads: the transfer thread records the ``h2d_*``
+    fields while the consumer credits ``feed_wait_s``/``step_s`` and calls
+    ``reset()`` at epoch start. The original lock-free disjoint-field design
+    was safe only until ``reset()`` raced a late in-flight ``record_h2d``
+    from the previous epoch's draining pipeline — graftrace flagged the
+    pair, and one coarse lock (two uncontended acquisitions per batch)
+    closes it for every field.
 
     - ``h2d_bytes`` / ``h2d_s``: payload bytes moved host->device and the
       true wire seconds (measured around a blocking device_put in the
@@ -184,28 +189,40 @@ class FeedStats:
     """
 
     def __init__(self):
+        self._lock = tsan.instrument_lock(threading.Lock(), "FeedStats._lock")
         self.reset()
 
     def reset(self):
-        self.h2d_bytes = 0
-        self.h2d_s = 0.0
-        self.h2d_transfers = 0
-        self.feed_wait_s = 0.0
-        self.step_s = 0.0
+        with self._lock:
+            self.h2d_bytes = 0  # guarded-by: self._lock
+            self.h2d_s = 0.0  # guarded-by: self._lock
+            self.h2d_transfers = 0  # guarded-by: self._lock
+            self.feed_wait_s = 0.0  # guarded-by: self._lock
+            self.step_s = 0.0  # guarded-by: self._lock
 
     def record_h2d(self, nbytes: int, seconds: float):
-        self.h2d_bytes += int(nbytes)
-        self.h2d_s += seconds
-        self.h2d_transfers += 1
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.h2d_s += seconds
+            self.h2d_transfers += 1
+            tsan.shared_access("FeedStats.fields")
+
+    def credit(self, field: str, seconds: float) -> None:
+        """Add consumer-side seconds to ``feed_wait_s``/``step_s`` (the
+        ``timed_consume`` sink — one locked add per region exit)."""
+        with self._lock:
+            setattr(self, field, getattr(self, field) + seconds)
+            tsan.shared_access("FeedStats.fields")
 
     def as_dict(self) -> dict:
-        return {
-            "h2d_bytes": self.h2d_bytes,
-            "h2d_s": round(self.h2d_s, 4),
-            "h2d_transfers": self.h2d_transfers,
-            "feed_wait_s": round(self.feed_wait_s, 4),
-            "step_s": round(self.step_s, 4),
-        }
+        with self._lock:
+            return {
+                "h2d_bytes": self.h2d_bytes,
+                "h2d_s": round(self.h2d_s, 4),
+                "h2d_transfers": self.h2d_transfers,
+                "feed_wait_s": round(self.feed_wait_s, 4),
+                "step_s": round(self.step_s, 4),
+            }
 
 
 class DeviceFeed:
@@ -283,10 +300,4 @@ class timed_consume:
         return self
 
     def __exit__(self, *exc):
-        setattr(
-            self._stats,
-            self._field,
-            getattr(self._stats, self._field)
-            + time.perf_counter()
-            - self._t0,
-        )
+        self._stats.credit(self._field, time.perf_counter() - self._t0)

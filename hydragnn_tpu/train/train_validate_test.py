@@ -160,7 +160,12 @@ class TrainingDriver:
         # (train_epoch / evaluate): filled by the device-feed pipeline,
         # credited into the Timer registry, read by bench.py.
         self.feed_stats = FeedStats()
-        self._sharding_trees: dict = {}  # batch structure -> NamedSharding tree
+        # Batch structure -> NamedSharding tree. Written from the
+        # transfer thread AND the main-thread eval path; safe without a
+        # lock because it is an idempotent memo (the value for a key is
+        # deterministic, dict get/set are single-bytecode atomic under
+        # the GIL, and a racing duplicate store just re-memoizes).
+        self._sharding_trees: dict = {}  # guarded-by: none(idempotent memo; deterministic value per key; GIL-atomic dict ops; duplicate store is a benign re-memoization)
 
     # ----------------------------------------------------------- device feed
     def _sharding_tree(self, batch):

@@ -7,17 +7,27 @@ all-gather via multihost_utils instead of torch.distributed reduce."""
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict
 
 import jax
 
+from ..analysis import tsan
+
 
 class Timer:
-    """Accumulating named timer; class-level registry like the reference."""
+    """Accumulating named timer; class-level registry like the reference.
 
-    _totals: Dict[str, float] = {}
-    _counts: Dict[str, int] = {}
+    The registry is written from the main thread (start/stop pairs) AND from
+    the pipeline/serve worker threads (``credit`` — the transfer thread's H2D
+    wire time, every ``serve_*`` stage). Unlocked, two concurrent credits to
+    the same name lose one update; the class lock closes that (graftrace
+    ``unguarded-shared-write``)."""
+
+    _totals: Dict[str, float] = {}  # guarded-by: Timer._lock
+    _counts: Dict[str, int] = {}  # guarded-by: Timer._lock
+    _lock = tsan.instrument_lock(threading.Lock(), "Timer._lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -32,8 +42,11 @@ class Timer:
         if self._start is None:
             raise RuntimeError(f"Timer {self.name} not started")
         elapsed = time.perf_counter() - self._start
-        Timer._totals[self.name] = Timer._totals.get(self.name, 0.0) + elapsed
-        Timer._counts[self.name] = Timer._counts.get(self.name, 0) + 1
+        with Timer._lock:
+            Timer._totals[self.name] = (
+                Timer._totals.get(self.name, 0.0) + elapsed
+            )
+            Timer._counts[self.name] = Timer._counts.get(self.name, 0) + 1
         self._start = None
         return elapsed
 
@@ -52,20 +65,30 @@ class Timer:
         hold a start/stop Timer across threads)."""
         if seconds <= 0:
             return
-        cls._totals[name] = cls._totals.get(name, 0.0) + seconds
-        cls._counts[name] = cls._counts.get(name, 0) + 1
+        with cls._lock:
+            cls._totals[name] = cls._totals.get(name, 0.0) + seconds
+            cls._counts[name] = cls._counts.get(name, 0) + 1
+            tsan.shared_access("Timer.registry")
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, float]:
+        """Locked copy of the totals — every reader outside the class goes
+        through this (reporting must not see a mid-update registry)."""
+        with cls._lock:
+            return dict(cls._totals)
 
     @classmethod
     def reset(cls):
-        cls._totals.clear()
-        cls._counts.clear()
+        with cls._lock:
+            cls._totals.clear()
+            cls._counts.clear()
 
 
 def reduce_timers() -> Dict[str, Dict[str, float]]:
     """Per-timer min/max/avg across processes (rank-0 meaningful)."""
     stats = {}
     nproc = jax.process_count()
-    for name, total in Timer._totals.items():
+    for name, total in Timer.snapshot().items():
         if nproc > 1:
             from jax.experimental import multihost_utils
             import numpy as np

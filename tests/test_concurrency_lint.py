@@ -1,0 +1,897 @@
+"""graftrace (hydragnn_tpu/analysis/concurrency.py + tsan.py) — tier-1.
+
+One positive fixture (the planted violation is caught, with the right rule
+id and line) and one negative fixture (the disciplined idiom passes) per
+concurrency rule, the ``guarded-by`` declaration grammar, the suppression +
+baseline policy (``unguarded-shared-write`` is never baselineable), the
+thread-topology model (Thread names, DeviceFeed bindings, HTTP handlers),
+the runtime sanitizer (dynamic inversion + unregistered-access detection,
+seeded-schedule determinism), a deterministic end-to-end drill over the
+serve + async-checkpoint paths, and the repo-wide clean-run gate for
+``python -m hydragnn_tpu.analysis trace``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hydragnn_tpu.analysis import save_baseline, trace_paths
+from hydragnn_tpu.analysis import tsan
+from hydragnn_tpu.analysis.baseline import load_baseline
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _trace_file(tmp_path, source, relname="mod.py", **kw):
+    path = tmp_path / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return trace_paths([str(tmp_path)], root=str(tmp_path), **kw)
+
+
+def _rules(report):
+    return {(v.rule, v.line) for v in report.violations}
+
+
+def _rule_ids(report):
+    return {v.rule for v in report.violations}
+
+
+# A two-root skeleton: `worker` runs on its own thread, everything else on
+# main — the minimal shape that makes an attribute "shared".
+_TWO_ROOT = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.counter = 0{decl}
+            threading.Thread(target=self._worker, name="worker").start()
+
+        def _worker(self):
+            {worker_body}
+
+        def bump(self):
+            {main_body}
+    """
+
+
+# ---------------------------------------------------------- missing-guard-decl
+def pytest_missing_guard_decl_positive(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="",
+            worker_body="self.counter += 1",
+            main_body="self.counter += 1",
+        ),
+    )
+    assert ("missing-guard-decl", 11) in _rules(report)
+    [v] = [x for x in report.violations if x.rule == "missing-guard-decl"]
+    assert "worker" in v.message and "main" in v.message
+
+
+def pytest_missing_guard_decl_negative_single_root(tmp_path):
+    """An attribute only the worker thread writes is thread-local state —
+    no declaration demanded."""
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="",
+            worker_body="self.counter += 1",
+            main_body="pass",
+        ),
+    )
+    assert "missing-guard-decl" not in _rule_ids(report)
+
+
+def pytest_init_writes_are_prepublication(tmp_path):
+    """__init__ writes never count toward sharing: construction happens
+    before the object escapes to other threads."""
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="",
+            worker_body="self.counter += 1",
+            main_body="pass",
+        ),
+    )
+    assert not _rule_ids(report)
+
+
+# ------------------------------------------------------- unguarded-shared-write
+def pytest_unguarded_shared_write_positive(tmp_path):
+    """The planted unguarded write: declared guarded, written bare."""
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="  # guarded-by: self._lock",
+            worker_body="""with self._lock:
+                self.counter += 1""",
+            main_body="self.counter += 1",
+        ),
+    )
+    got = _rules(report)
+    assert ("unguarded-shared-write", 15) in got
+    assert ("unguarded-shared-write", 12) not in got  # the locked write
+
+
+def pytest_guarded_write_negative(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="  # guarded-by: self._lock",
+            worker_body="""with self._lock:
+                self.counter += 1""",
+            main_body="""with self._lock:
+                self.counter += 1""",
+        ),
+    )
+    assert not _rule_ids(report)
+
+
+def pytest_container_mutation_is_a_write(tmp_path):
+    """self.items.append(...) mutates the shared container — same rule."""
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: self._lock
+                threading.Thread(target=self._worker, name="worker").start()
+
+            def _worker(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def push(self):
+                self.items.append(2)
+        """,
+    )
+    assert ("unguarded-shared-write", 15) in _rules(report)
+
+
+# --------------------------------------------------------------- guard-mismatch
+def pytest_guard_mismatch_wrong_lock_positive(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other_lock = threading.Lock()
+                self.counter = 0  # guarded-by: self._lock
+                threading.Thread(target=self._worker, name="worker").start()
+
+            def _worker(self):
+                with self._lock:
+                    self.counter += 1
+
+            def bump(self):
+                with self._other_lock:
+                    self.counter += 1
+        """,
+    )
+    got = _rules(report)
+    assert ("guard-mismatch", 17) in got
+    assert ("unguarded-shared-write", 17) not in got  # wrong lock != no lock
+
+
+def pytest_guard_mismatch_unlocked_read_positive(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="  # guarded-by: self._lock",
+            worker_body="""with self._lock:
+                self.counter += 1""",
+            main_body="return self.counter",
+        ),
+    )
+    [v] = [x for x in report.violations if x.rule == "guard-mismatch"]
+    assert v.line == 15
+    assert "dirty-reads" in v.message  # the fix is named in the message
+
+
+def pytest_dirty_reads_clause_exempts_reads_not_writes(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="  # guarded-by: self._lock, dirty-reads(monotonic counter; stale ok)",
+            worker_body="""with self._lock:
+                self.counter += 1""",
+            main_body="return self.counter",
+        ),
+    )
+    assert not _rule_ids(report)
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="  # guarded-by: self._lock, dirty-reads(monotonic counter; stale ok)",
+            worker_body="""with self._lock:
+                self.counter += 1""",
+            main_body="self.counter += 1",  # a WRITE still needs the lock
+        ),
+        relname="mod2.py",
+    )
+    assert "unguarded-shared-write" in _rule_ids(report)
+
+
+# ------------------------------------------------------------ declaration grammar
+def pytest_none_and_external_require_reasons(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self.a = 0  # guarded-by: none
+                self.b = 0  # guarded-by: none(idempotent memo; GIL-atomic store)
+                self.c = {}  # guarded-by: external(ServeMetrics records under ITS lock)
+                threading.Thread(target=self._worker, name="worker").start()
+
+            def _worker(self):
+                self.a += 1
+                self.b += 1
+                self.c["k"] = 1
+
+            def bump(self):
+                self.a += 1
+                self.b += 1
+                self.c["k"] = 2
+        """,
+    )
+    [v] = [x for x in report.violations if x.rule == "missing-guard-decl"]
+    assert v.line == 6  # bare `none` is an unexplained prose invariant
+    assert "requires a reason" in v.message
+    # b and c carry reasons: no further discipline demanded.
+    assert len(report.violations) == 1
+
+
+def pytest_trailing_decl_binds_to_its_own_line_only(tmp_path):
+    """A trailing guarded-by on line N must NOT leak onto line N+1's
+    attribute (the declaration the annotator never wrote)."""
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = 0  # guarded-by: self._lock
+                self.b = 0
+                threading.Thread(target=self._worker, name="worker").start()
+
+            def _worker(self):
+                with self._lock:
+                    self.a += 1
+                self.b += 1
+        """,
+    )
+    # b has no declaration: single-root write -> silent; crucially there is
+    # NO unguarded-shared-write from a.=s decl bleeding onto b.
+    assert "unguarded-shared-write" not in _rule_ids(report)
+    # A standalone comment line above the assignment DOES declare:
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # guarded-by: self._lock
+                self.a = 0
+                threading.Thread(target=self._worker, name="worker").start()
+
+            def _worker(self):
+                with self._lock:
+                    self.a += 1
+
+            def bump(self):
+                self.a += 1
+        """,
+        relname="mod2.py",
+    )
+    assert ("unguarded-shared-write", 16) in _rules(report)
+
+
+def pytest_lock_name_prefixed_none_is_a_lock_not_the_none_form(tmp_path):
+    """A lock whose name merely STARTS with 'none'/'external' must parse as
+    a lock reference, not as the reason-requiring none/external form."""
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        nonelock = threading.Lock()
+        counter = 0  # guarded-by: nonelock
+
+        def launch():
+            threading.Thread(target=work, name="worker").start()
+
+        def work():
+            global counter
+            with nonelock:
+                counter += 1
+
+        def bump():
+            global counter
+            with nonelock:
+                counter += 1
+        """,
+    )
+    assert not _rule_ids(report)
+
+
+# --------------------------------------------------------- lock-order-inversion
+_CYCLE = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+
+
+def pytest_lock_order_inversion_positive(tmp_path):
+    """The planted lock-order cycle: a->b in one function, b->a in another."""
+    report = _trace_file(tmp_path, _CYCLE)
+    [v] = [x for x in report.violations if x.rule == "lock-order-inversion"]
+    assert "C._a" in v.message and "C._b" in v.message
+    assert report.lock_cycles  # surfaced structurally too
+
+
+def pytest_consistent_order_negative(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """,
+    )
+    assert "lock-order-inversion" not in _rule_ids(report)
+    assert ("C._a" in e[0] and "C._b" in e[1] for e in report.lock_edges)
+
+
+def pytest_lock_order_through_calls(tmp_path):
+    """The cycle hides behind a call: holding A, call a function that takes
+    B; elsewhere the orders reverse. Transitive may-acquire finds it."""
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def take_b(self):
+                with self._b:
+                    pass
+
+            def take_a(self):
+                with self._a:
+                    pass
+
+            def one(self):
+                with self._a:
+                    self.take_b()
+
+            def two(self):
+                with self._b:
+                    self.take_a()
+        """,
+    )
+    assert "lock-order-inversion" in _rule_ids(report)
+
+
+# ------------------------------------------------------- blocking-queue-in-lock
+def pytest_blocking_in_lock_positive(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    return self._q.get()
+        """,
+    )
+    [v] = [x for x in report.violations if x.rule == "blocking-queue-in-lock"]
+    assert v.line == 12 and "_q.get()" in v.message
+
+
+def pytest_bounded_wait_negative(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def ok_timeout(self):
+                with self._lock:
+                    return self._q.get(timeout=0.1)
+
+            def ok_nonblocking(self):
+                with self._lock:
+                    self._q.put(1, block=False)
+
+            def ok_outside(self):
+                return self._q.get()
+        """,
+    )
+    assert "blocking-queue-in-lock" not in _rule_ids(report)
+
+
+def pytest_blocking_through_call_positive(tmp_path):
+    """Holding the lock while CALLING something that blocks is the same
+    convoy — the transitive half of the rule."""
+    report = _trace_file(
+        tmp_path,
+        """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def drain(self):
+                return self._q.get()
+
+            def bad(self):
+                with self._lock:
+                    return self.drain()
+        """,
+    )
+    [v] = [x for x in report.violations if x.rule == "blocking-queue-in-lock"]
+    assert "drain" in v.message
+
+
+# ----------------------------------------------------------- fork-after-threads
+def pytest_fork_after_threads_positive(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import os
+        import threading
+
+        def launch():
+            threading.Thread(target=work, name="worker").start()
+
+        def work():
+            pass
+
+        def bad():
+            os.fork()
+        """,
+    )
+    [v] = [x for x in report.violations if x.rule == "fork-after-threads"]
+    assert v.line == 12
+
+
+def pytest_spawn_context_negative(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import multiprocessing
+        import threading
+
+        def launch():
+            threading.Thread(target=work, name="worker").start()
+
+        def work():
+            pass
+
+        def ok():
+            ctx = multiprocessing.get_context("spawn")
+            multiprocessing.Process(target=work)
+        """,
+    )
+    assert "fork-after-threads" not in _rule_ids(report)
+
+
+# -------------------------------------------------------- jax-dispatch-off-main
+def pytest_jax_dispatch_off_main_positive(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        import threading
+
+        import jax.numpy as jnp
+
+        def launch():
+            threading.Thread(target=work, name="rogue").start()
+
+        def work():
+            return jnp.zeros((2,))
+        """,
+    )
+    [v] = [x for x in report.violations if x.rule == "jax-dispatch-off-main"]
+    assert "rogue" in v.message
+
+
+def pytest_jax_dispatch_sanctioned_roots_negative(tmp_path):
+    """Main-thread dispatch and the DeviceFeed transfer stage are the
+    sanctioned device paths — the topology model must see that the callable
+    BOUND INTO DeviceFeed(transfer=...) runs on feed-transfer."""
+    report = _trace_file(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def on_main():
+            return jnp.ones((2,))
+
+        def host_stage():
+            yield 1
+
+        def transfer_stage(x):
+            return jnp.asarray(x)
+
+        def build():
+            return DeviceFeed(host_stage(), transfer=transfer_stage)
+        """,
+    )
+    assert "jax-dispatch-off-main" not in _rule_ids(report)
+    assert "feed-transfer" in report.thread_roots
+    assert "feed-host" in report.thread_roots
+    # ...and the HOST stage dispatching jax IS flagged:
+    report = _trace_file(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def host_stage():
+            yield jnp.ones((2,))
+
+        def build():
+            return DeviceFeed(host_stage(), transfer=lambda x: x)
+        """,
+        relname="mod2.py",
+    )
+    assert "jax-dispatch-off-main" in _rule_ids(report)
+
+
+# ------------------------------------------------------------- thread topology
+def pytest_topology_discovers_http_handlers(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        """
+        from http.server import BaseHTTPRequestHandler
+
+        class H(BaseHTTPRequestHandler):
+            hits = 0
+
+            def do_GET(self):
+                H.hits += 1
+
+        def main_tick():
+            H.hits += 1
+        """,
+    )
+    assert "http-handler" in report.thread_roots
+    # hits is written from {http-handler, main} and carries no declaration.
+    assert "missing-guard-decl" in _rule_ids(report)
+
+
+# --------------------------------------------------- suppression + baseline policy
+def pytest_suppression_requires_reason(tmp_path):
+    src = _TWO_ROOT.format(
+        decl="  # guarded-by: self._lock",
+        worker_body="""with self._lock:
+                self.counter += 1""",
+        main_body="self.counter += 1{sup}",
+    )
+    with_reason = _trace_file(
+        tmp_path,
+        src.format(
+            sup="  # graftrace: disable=unguarded-shared-write(drill fixture; single-writer in prod)"
+        ),
+    )
+    assert not with_reason.violations
+    assert [v.rule for v in with_reason.suppressed] == [
+        "unguarded-shared-write"
+    ]
+    bare = _trace_file(
+        tmp_path,
+        src.format(sup="  # graftrace: disable=unguarded-shared-write"),
+        relname="mod2.py",
+    )
+    assert "suppression-without-reason" in _rule_ids(bare)
+
+
+def pytest_unguarded_shared_write_never_baselineable(tmp_path):
+    report = _trace_file(
+        tmp_path,
+        _TWO_ROOT.format(
+            decl="  # guarded-by: self._lock",
+            worker_body="""with self._lock:
+                self.counter += 1""",
+            main_body="self.counter += 1",
+        ),
+    )
+    assert "unguarded-shared-write" in _rule_ids(report)
+    with pytest.raises(ValueError, match="never grandfathered"):
+        save_baseline(report, str(tmp_path / "baseline.json"))
+    # ...and a hand-crafted baseline carrying such an entry refuses to LOAD.
+    crafted = tmp_path / "crafted.json"
+    crafted.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "entries": {"mod.py::C.bump::unguarded-shared-write": 1},
+            }
+        )
+    )
+    with pytest.raises(ValueError, match="never-grandfathered"):
+        load_baseline(str(crafted))
+
+
+def pytest_single_pass_baseline_update_preserves_other_pass(tmp_path):
+    """`trace --update-baseline` owns only the concurrency rules' rows —
+    it must not clobber the lint pass's grandfathered entries in the
+    shared file (and vice versa for `lint --no-trace`)."""
+    shared = tmp_path / "baseline.json"
+    lint_entry = "somewhere.py::f::recompile-hazard"
+    shared.write_text(
+        json.dumps({"version": 1, "entries": {lint_entry: 1}})
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "hydragnn_tpu.analysis",
+            "trace",
+            "--baseline",
+            str(shared),
+            "--update-baseline",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        env=_ENV,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    kept = json.loads(shared.read_text())["entries"]
+    assert kept.get(lint_entry) == 1, kept
+
+
+# ------------------------------------------------------------- runtime sanitizer
+@pytest.fixture
+def tsan_session():
+    tsan.enable(seed=0)
+    tsan.reset()
+    yield tsan
+    tsan.disable()
+    tsan.reset()
+
+
+def pytest_tsan_records_dynamic_inversion(tsan_session):
+    a = tsan.instrument_lock(threading.Lock(), "A")
+    b = tsan.instrument_lock(threading.Lock(), "B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab, name="t-ab")
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba, name="t-ba")
+    t2.start()
+    t2.join()
+    rep = tsan.report()
+    assert "A -> B" in rep["lock_edges"] and "B -> A" in rep["lock_edges"]
+    [inv] = rep["dynamic_inversions"]
+    assert {inv["first_thread"], inv["second_thread"]} == {"t-ab", "t-ba"}
+    # The merged cross-check flags the cycle even with no static edges.
+    cross = tsan.cross_check([])
+    assert not cross["ok"] and cross["merged_cycles"]
+
+
+def pytest_tsan_detects_unregistered_cross_thread_access(tsan_session):
+    lock = tsan.instrument_lock(threading.Lock(), "L")
+
+    def guarded():
+        with lock:
+            tsan.shared_access("site.counter")
+
+    t = threading.Thread(target=guarded, name="t-guarded")
+    t.start()
+    t.join()
+    tsan.shared_access("site.counter")  # main thread, NO lock held
+    rep = tsan.report()
+    [finding] = rep["unregistered_cross_thread"]
+    assert finding["site"] == "site.counter"
+    assert finding["locks_b"] == "<none>"
+    assert not tsan.cross_check([])["ok"]
+
+
+def pytest_tsan_common_lock_is_registered_access(tsan_session):
+    lock = tsan.instrument_lock(threading.Lock(), "L")
+
+    def guarded():
+        with lock:
+            tsan.shared_access("site.ok")
+
+    t = threading.Thread(target=guarded, name="t-guarded")
+    t.start()
+    t.join()
+    guarded()  # main thread, same lock
+    rep = tsan.report()
+    assert rep["unregistered_cross_thread"] == []
+    assert sorted(rep["shared_sites"]["site.ok"]) == [
+        "MainThread",
+        "t-guarded",
+    ]
+
+
+def pytest_tsan_disabled_is_zero_cost(tmp_path):
+    tsan.disable()
+    lock = threading.Lock()
+    assert tsan.instrument_lock(lock, "X") is lock  # no proxy when off
+    tsan.shared_access("never.recorded")
+    tsan.yield_point("never.recorded")
+    assert tsan.report()["yield_counts"] == {}
+
+
+def pytest_tsan_seeded_schedule_is_deterministic(tsan_session):
+    """The same seed replays the same per-site decision stream; a different
+    seed diverges (64 ternary decisions: collision odds 3^-64)."""
+
+    def run(seed):
+        tsan.enable(seed=seed)
+        tsan.reset()
+        done = threading.Event()
+
+        def worker():
+            for _ in range(32):
+                tsan.yield_point("drill.site")
+            done.set()
+
+        t = threading.Thread(target=worker, name="drill")
+        t.start()
+        for _ in range(32):
+            tsan.yield_point("drill.site")
+        t.join()
+        assert done.wait(5)
+        return tsan.schedule("drill.site")
+
+    first = run(11)
+    again = run(11)
+    other = run(12)
+    assert len(first) == 64
+    assert first == again
+    assert first != other
+
+
+# ------------------------------------------------- end-to-end drill + clean gate
+@pytest.mark.mpi_skip()
+def pytest_trace_clean_over_repo():
+    """`python -m hydragnn_tpu.analysis trace` over the package: zero
+    violations, zero reason-less suppressions, acyclic lock-order graph,
+    all five host thread roots discovered."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.analysis", "trace", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+        env=_ENV,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] and doc["violations"] == []
+    assert doc["lock_cycles"] == []
+    assert doc["files"] > 50
+    for root in (
+        "feed-host",
+        "feed-transfer",
+        "ckpt-writer",
+        "hydragnn-serve-dispatch",
+        "http-handler",
+    ):
+        assert root in doc["thread_roots"], doc["thread_roots"]
+    # The concurrency layer is actually inventoried, not vacuously clean.
+    assert len(doc["shared_attrs"]) >= 10
+    assert doc["declared_attrs"] >= 20
+    assert doc["rule_counts"]["unguarded-shared-write"] == 0
+
+
+@pytest.mark.mpi_skip()
+@pytest.mark.slow
+def pytest_tsan_drill_deterministic_and_clean(tmp_path):
+    """The HYDRAGNN_TSAN=1 drill over the serve + async-checkpoint paths:
+    no dynamic lock-order inversion, no unregistered cross-thread access,
+    static/dynamic cross-check clean — and the seeded interleaving
+    reproduces bit-identically on a second run."""
+
+    def drill(seed):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join("benchmarks", "tsan_drill.py"),
+                "--seed",
+                str(seed),
+                "--json",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=_REPO,
+            env=_ENV,
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    first = drill(7)
+    assert first["ok"]
+    assert first["dynamic_inversions"] == []
+    assert first["unregistered_cross_thread"] == []
+    assert first["cross_check"]["merged_cycles"] == []
+    # The drill exercised both paths: the annotated sites actually fired.
+    assert first["yield_counts"].get("ckpt.save.pre_enqueue", 0) > 0
+    assert first["yield_counts"].get("serve.submit.pre_enqueue", 0) > 0
+    again = drill(7)
+    assert again["schedule_sha256"] == first["schedule_sha256"]
+    assert again["deterministic_sites"] == first["deterministic_sites"]
