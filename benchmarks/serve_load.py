@@ -348,6 +348,21 @@ def run_serve_benchmark(
     block["histogram_artifact"] = os.path.basename(hist_path)
     block["unpacked"] = unpacked
     block["ab_summary"] = _ab_summary(unpacked, packed)
+    # graftel census (docs/OBSERVABILITY.md): which serve stages traced
+    # during the load — the unified-registry corroboration that every
+    # submitted request left a correlated span trail (ring-windowed; the
+    # ring holds the most recent 4096 records).
+    from hydragnn_tpu import telemetry
+
+    block["telemetry"] = {
+        "span_counts": {
+            name: n
+            for name, n in sorted(telemetry.span_counts(
+                telemetry.snapshot_records()
+            ).items())
+            if name.startswith("serve/")
+        },
+    }
     with open(out_path, "w") as f:
         json.dump(block, f, indent=2)
     block["artifact"] = os.path.basename(out_path)

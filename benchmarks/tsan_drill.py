@@ -13,9 +13,10 @@ lock-order graph (``python -m hydragnn_tpu.analysis trace``): a dynamic
 acquisition order the static model missed, a dynamic inversion, or an
 unregistered cross-thread access all fail the run (exit 1).
 
-    HYDRAGNN_TSAN is forced on BEFORE any hydragnn import, so class-level
-    locks created at import time (Timer, FaultCounters) are instrumented
-    too — running this module IS the HYDRAGNN_TSAN=1 drill.
+    HYDRAGNN_TSAN is forced on BEFORE any hydragnn import, so module-level
+    locks created at import time (graftel._lock — the registry behind
+    Timer/FaultCounters since the telemetry PR) are instrumented too —
+    running this module IS the HYDRAGNN_TSAN=1 drill.
 
     python benchmarks/tsan_drill.py [--seed N] [--json]
 
@@ -46,8 +47,9 @@ def _preparse(flag: str, argv, default: str) -> str:
 _SEED = int(_preparse("--seed", sys.argv[1:], "0") or 0)
 
 # BEFORE any hydragnn/jax import: the tsan module reads these at import, and
-# class-level locks (Timer._lock, FaultCounters._lock) wrap only if the flag
-# is up when their defining modules load.
+# import-time locks (graftel._lock — the shared registry behind Timer and
+# FaultCounters since the telemetry PR) wrap only if the flag is up when
+# their defining modules load.
 os.environ["HYDRAGNN_TSAN"] = "1"
 os.environ["HYDRAGNN_TSAN_SEED"] = str(_SEED)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -112,12 +114,46 @@ def _serve_drill() -> None:
         engine.close()
 
 
+def _telemetry_drill(tmpdir: str) -> None:
+    """graftel path: concurrent spans/events/counters from worker threads
+    racing a flight dump on the main thread — the tracer's single registry
+    lock (graftel._lock, instrumented at import under HYDRAGNN_TSAN=1) under
+    schedule perturbation. The serve/checkpoint drills already emit through
+    graftel implicitly; this section hammers it directly."""
+    import threading
+
+    from hydragnn_tpu import telemetry
+
+    telemetry.configure(run_dir=tmpdir, collect=True)
+    ctx = telemetry.new_context()
+
+    def worker(wid: int):
+        telemetry.attach(ctx)
+        for i in range(16):
+            with telemetry.span("tsan_drill/span", worker=wid, i=i):
+                telemetry.counter("tsan_drill/ops")
+            telemetry.event("tsan_drill/event", worker=wid)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(3)
+    ]
+    for t in threads:
+        t.start()
+    telemetry.flight_dump("tsan_drill")
+    telemetry.render_prometheus()
+    for t in threads:
+        t.join(30)
+    telemetry.configure(collect=False)
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
     with tempfile.TemporaryDirectory() as tmpdir:
         _checkpoint_drill(tmpdir)
         _serve_drill()
+        _telemetry_drill(tmpdir)
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

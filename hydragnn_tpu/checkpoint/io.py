@@ -469,6 +469,14 @@ def load_verified_chain(
             "epochs_lost": epochs_lost,
         }
         FaultCounters.inc("ckpt_fallback_loads")
+        # Flight-recorder trigger (docs/OBSERVABILITY.md): the timeline that
+        # led into a fallback load — what was happening when the latest
+        # checkpoint turned out corrupt — next to the supervisor.json record.
+        from ..telemetry import graftel as telemetry
+
+        telemetry.flight_dump(
+            "checkpoint_fallback", run_dir=run_dir, extra=report
+        )
         if _is_rank_zero():
             try:
                 record_checkpoint_fallback(

@@ -7,44 +7,44 @@ silent — `print_timers` appends the counts to the end-of-run report,
 ``bench.py --faults`` embeds the snapshot in the drill artifact, and the
 serving layer mirrors its own engine-scoped counters into Prometheus.
 
-Class-level registry like ``Timer`` (utils/time_utils.py) — counters arrive
-from the pipeline's host/transfer threads, the training driver, and loader
-construction, so increments are lock-protected.
+Class-level API like ``Timer`` (utils/time_utils.py); since the graftel PR
+the storage is the process-wide telemetry registry (telemetry/graftel.py,
+``fault/<name>`` keys) — counters arrive from the pipeline's host/transfer
+threads, the training driver, and loader construction, and every increment
+also lands in the flight-recorder ring as an event, so a dump taken at a
+guard trip shows WHICH survival mechanisms fired and when.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
 
-from ..analysis import tsan
+from ..telemetry import graftel as telemetry
+
+_PREFIX = "fault/"
 
 
 class FaultCounters:
-    """Accumulating named integer counters; class-level registry."""
-
-    _counts: Dict[str, int] = {}  # guarded-by: FaultCounters._lock
-    _lock = tsan.instrument_lock(threading.Lock(), "FaultCounters._lock")
+    """Accumulating named integer counters; graftel-backed registry."""
 
     @classmethod
     def inc(cls, name: str, n: int = 1) -> None:
         if n <= 0:
             return
-        with cls._lock:
-            cls._counts[name] = cls._counts.get(name, 0) + int(n)
-            tsan.shared_access("FaultCounters.registry")
+        telemetry.counter(_PREFIX + name, int(n))
+        telemetry.event(_PREFIX + name, n=int(n))
 
     @classmethod
     def get(cls, name: str) -> int:
-        with cls._lock:
-            return cls._counts.get(name, 0)
+        return int(telemetry.counter_value(_PREFIX + name))
 
     @classmethod
     def snapshot(cls) -> Dict[str, int]:
-        with cls._lock:
-            return dict(cls._counts)
+        return {
+            k[len(_PREFIX):]: int(v)
+            for k, v in telemetry.counters_snapshot(_PREFIX).items()
+        }
 
     @classmethod
     def reset(cls) -> None:
-        with cls._lock:
-            cls._counts.clear()
+        telemetry.clear_counters(_PREFIX)

@@ -27,6 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import graftel as telemetry
 from ..utils.optimizer import get_learning_rate, set_learning_rate
 from ..utils.print_utils import print_distributed
 from .counters import FaultCounters
@@ -109,8 +110,22 @@ class StepGuard:
                 self.take_snapshot(driver.state)
             return False
         n = int(round(bad))
+        streak_started = self.consecutive <= 0.0
         self.bad_steps += n
         FaultCounters.inc("bad_steps", n)
+        if streak_started:
+            # Flight-recorder trigger (docs/OBSERVABILITY.md): the ring holds
+            # the offending step's collate/h2d/device spans right now — dump
+            # once per bad streak, not once per skipped step, so a 3-step
+            # divergence produces one timeline, not three near-copies.
+            telemetry.flight_dump(
+                "guard_trip",
+                extra={
+                    "bad_steps_this_update": n,
+                    "bad_steps_total": self.bad_steps,
+                    "max_bad_steps": self.max_bad_steps,
+                },
+            )
         print_distributed(
             self.verbosity,
             f"StepGuard: skipped {n} non-finite step(s) "
@@ -143,6 +158,11 @@ class StepGuard:
                     )
         self.rollbacks += 1
         FaultCounters.inc("rollbacks")
+        telemetry.event(
+            "fault/guard_rollback",
+            rollbacks=self.rollbacks,
+            bad_steps=self.bad_steps,
+        )
         self.consecutive = 0.0
 
     @classmethod

@@ -164,6 +164,26 @@ def run_supervised(
         attempt += 1
         meta["restarts"] = attempt
         FaultCounters.inc("restarts")
+        # Flight-recorder trigger (docs/OBSERVABILITY.md): the supervisor's
+        # own timeline (attempt events, fault counters) at each child death —
+        # dumped into the run dir next to supervisor.json so "why did it
+        # restart" and "what restarted" live side by side.
+        from ..telemetry import graftel as telemetry
+
+        telemetry.event(
+            "fault/supervisor_restart",
+            attempt=attempt,
+            returncode=meta["attempts"][-1]["returncode"],
+        )
+        telemetry.flight_dump(
+            "supervisor_restart",
+            run_dir=run_dir,
+            extra={
+                "attempt": attempt,
+                "returncode": meta["attempts"][-1]["returncode"],
+                "max_restarts": int(max_restarts),
+            },
+        )
         meta = _write_meta(meta_path, meta)
 
 

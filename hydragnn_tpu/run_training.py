@@ -135,6 +135,24 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
     with open("./logs/" + log_name + "/config.json", "w") as f:
         json.dump(config, f)
 
+    # graftel (docs/OBSERVABILITY.md): point the flight recorder at this
+    # run's log dir (guard trips / checkpoint fallbacks / engine poisonings
+    # dump there) and turn on full span collection when asked — the
+    # ``Telemetry`` config block or HYDRAGNN_TRACE=1.
+    from . import telemetry
+
+    tele_cfg = config.get("Telemetry") or {}
+    collect_trace = bool(
+        os.environ.get("HYDRAGNN_TRACE", "0") not in ("", "0", "false", "False")
+        or tele_cfg.get("collect", 0)
+    )
+    telemetry.configure(
+        run_dir="./logs/" + log_name,
+        collect=collect_trace,
+        jax_annotations=bool(tele_cfg.get("jax_annotations", 0)),
+    )
+    telemetry.install_jax_hooks()
+
     state = create_train_state(model, variables, optimizer)
     # Warm start (Training.continue / startfrom).
     new_vars, opt_state = load_existing_model_config(
@@ -321,4 +339,21 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
     # run_prediction immediately after training) while rank 0 is still writing.
     barrier("final_checkpoint")
     print_timers(verbosity)
+    if world_rank == 0:
+        # Telemetry artifacts (docs/OBSERVABILITY.md): the Prometheus
+        # textfile of the registry (training gauges included) always; the
+        # JSONL event log + Chrome/Perfetto trace when collection was on.
+        run_dir = "./logs/" + log_name
+        try:
+            with open(os.path.join(run_dir, "train_metrics.prom"), "w") as f:
+                f.write(telemetry.render_prometheus())
+            if collect_trace:
+                telemetry.export_events_jsonl(
+                    os.path.join(run_dir, "trace_events.jsonl")
+                )
+                telemetry.export_chrome_trace(
+                    os.path.join(run_dir, "trace_chrome.json")
+                )
+        except OSError as e:
+            print_distributed(verbosity, f"telemetry export failed: {e}")
     return history

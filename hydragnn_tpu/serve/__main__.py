@@ -130,6 +130,23 @@ def main(argv=None) -> int:
         # operator flag must never be silently dropped, so if the gate let
         # the broken spec through, the original parse failure still aborts.
         raise parse_error
+    # graftel (docs/OBSERVABILITY.md): point the flight recorder at the
+    # run's log dir so an engine poisoning dumps its timeline next to the
+    # checkpoint it served.
+    import json as _json
+    import os as _os
+
+    from .. import telemetry
+    from ..utils.config_utils import get_log_name_config
+
+    try:
+        with open(args.config) as f:
+            _cfg = _json.load(f)
+        telemetry.configure(
+            run_dir=_os.path.join("./logs", get_log_name_config(_cfg))
+        )
+    except (OSError, ValueError, KeyError):
+        pass  # from_config reports config problems with better messages
     engine = InferenceEngine.from_config(
         args.config,
         checkpoint=args.ckpt,

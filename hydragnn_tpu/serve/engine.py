@@ -46,6 +46,7 @@ import pickle
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -55,6 +56,7 @@ from ..analysis import tsan
 from ..graphs.collate import GraphArena, round_up_pow2
 from ..graphs.packing import PackCaps, first_fit_decreasing
 from ..graphs.sample import GraphSample
+from ..telemetry import graftel as telemetry
 from ..train.pipeline import DeviceFeed
 from .metrics import ServeMetrics
 
@@ -93,12 +95,15 @@ class _Future:
     ``concurrent.futures.TimeoutError`` is not the builtin ``TimeoutError``
     callers naturally catch.)"""
 
-    __slots__ = ("_event", "_result", "_error")
+    __slots__ = ("_event", "_result", "_error", "request_id")
 
-    def __init__(self):
+    def __init__(self, request_id: Optional[str] = None):
         self._event = threading.Event()
         self._result = None
         self._error = None
+        # Correlation id (docs/OBSERVABILITY.md): assigned at submit, echoed
+        # by the HTTP layer as X-HydraGNN-Request-Id.
+        self.request_id = request_id
 
     def set_result(self, value) -> None:
         self._result = value
@@ -124,6 +129,7 @@ class _Request:
     sample: GraphSample
     future: _Future
     t_submit: float
+    request_id: str = ""
 
 
 @dataclass
@@ -263,6 +269,13 @@ class InferenceEngine:
         self._guard_outputs = bool(guard_outputs)
         self._restarts_left = int(max_worker_restarts)  # guarded-by: self._lock, dirty-reads(decremented only by _fail on the dispatch thread; budget off-by-one under a torn restart is acceptable degradation)
         self._degraded = False  # guarded-by: self._lock, dirty-reads(sticky monotonic bool; a stale False read only delays the /healthz downgrade by one scrape)
+        # Bounded log of degraded-state transitions, correlation ids
+        # included — surfaced by /healthz so "degraded: true" names the
+        # requests that tripped it (docs/OBSERVABILITY.md).
+        self._degraded_events: "deque" = deque(maxlen=16)  # guarded-by: self._lock
+        # Telemetry context of the CURRENT pipeline incarnation, handed to
+        # the feed threads + dispatcher (explicit cross-thread propagation).
+        self._pipeline_ctx = None  # guarded-by: self._lock, dirty-reads(rebound only by start(); stage threads read the ctx they were constructed with)
         # Per-incarnation stop flag for the batcher generator: on a worker
         # restart the OLD batcher must stop consuming the shared request
         # queue before the new one starts (two live batchers would race).
@@ -279,10 +292,15 @@ class InferenceEngine:
         if self._dispatcher is not None:
             return
         self._gen_stop = threading.Event()
+        # One telemetry context per pipeline incarnation: the batcher /
+        # transfer / dispatcher spans all parent here, so a flight-recorder
+        # dump shows which incarnation served which requests.
+        ctx = telemetry.new_context()
         feed = DeviceFeed(
             self._batch_source(self._gen_stop),
             transfer=self._transfer,
             host_depth=2,
+            ctx=ctx,
         )
         dispatcher = threading.Thread(
             target=self._dispatch_loop, name="hydragnn-serve-dispatch",
@@ -291,6 +309,7 @@ class InferenceEngine:
         with self._lock:
             self._feed = feed
             self._dispatcher = dispatcher
+            self._pipeline_ctx = ctx
         dispatcher.start()
 
     @property
@@ -351,9 +370,15 @@ class InferenceEngine:
         self.close()
 
     # ------------------------------------------------------------- requests
-    def submit(self, sample: GraphSample) -> _Future:
+    def submit(
+        self, sample: GraphSample, request_id: Optional[str] = None
+    ) -> _Future:
         """Enqueue one graph; returns a future resolving to the per-head
         output list ([dim] arrays for graph heads, [n, dim] for node heads).
+        ``request_id`` is the correlation id carried end-to-end (submit →
+        pack bin → device batch → demux → response; docs/OBSERVABILITY.md);
+        one is generated when the caller brings none. The id is available on
+        the returned future (``fut.request_id``).
         """
         if self._error is not None:
             raise EngineFailedError(
@@ -362,7 +387,19 @@ class InferenceEngine:
         if self._closing.is_set():
             raise EngineClosedError("engine is shut down")
         self._validate(sample)
-        req = _Request(sample=sample, future=_Future(), t_submit=time.perf_counter())
+        rid = request_id or telemetry.new_request_id()
+        req = _Request(
+            sample=sample,
+            future=_Future(request_id=rid),
+            t_submit=time.perf_counter(),
+            request_id=rid,
+        )
+        telemetry.event(
+            "serve/submit",
+            request_id=rid,
+            nodes=int(sample.num_nodes),
+            edges=int(sample.num_edges),
+        )
         with self._lock:
             self._pending.add(req.future)
         # Annotated interleaving site: the window between pending-set entry
@@ -375,6 +412,7 @@ class InferenceEngine:
             with self._lock:
                 self._pending.discard(req.future)
             self.metrics.count("rejected_total")
+            telemetry.event("serve/reject", request_id=rid)
             hint = self._retry_after_hint()
             raise BackpressureError(
                 f"request queue full ({self.queue_limit}); retry in "
@@ -399,10 +437,15 @@ class InferenceEngine:
         return req.future
 
     def predict(
-        self, samples: Sequence[GraphSample], timeout: Optional[float] = 60.0
+        self,
+        samples: Sequence[GraphSample],
+        timeout: Optional[float] = 60.0,
+        request_id: Optional[str] = None,
     ) -> List[List[np.ndarray]]:
         """Synchronous convenience: submit all, wait all. Returns one
-        per-head output list per input graph.
+        per-head output list per input graph. A multi-graph call shares one
+        ``request_id`` base (the HTTP layer's correlation id); each graph
+        gets ``<request_id>/<i>``.
 
         All samples are validated BEFORE any is admitted (a malformed graph
         rejects the call without consuming device work), and a multi-graph
@@ -426,10 +469,11 @@ class InferenceEngine:
                 f"slots; retry in ~{hint:.2f}s",
                 retry_after_s=hint,
             )
+        rid = request_id or telemetry.new_request_id()
         futures = []
         try:
-            for s in samples:
-                futures.append(self.submit(s))
+            for i, s in enumerate(samples):
+                futures.append(self.submit(s, request_id=f"{rid}/{i}"))
         except BackpressureError:
             # Lost the capacity race to concurrent callers: the already-
             # admitted graphs will compute regardless — drain them so the
@@ -547,8 +591,10 @@ class InferenceEngine:
                         self._reject(req, e)
                     self.metrics.count("errors_total")
                     self.metrics.count("bad_batches_total")
-                    with self._lock:
-                        self._degraded = True
+                    self._mark_degraded(
+                        "collation_failure",
+                        [r.request_id for r in group],
+                    )
                     continue
                 yield work
             if saw_shutdown:
@@ -591,18 +637,23 @@ class InferenceEngine:
         # stage decomposition must not double-count collate seconds.
         for r in entries:
             self.metrics.observe("queue_wait", t0 - r.t_submit)
-        samples = [r.sample for r in entries]
-        arena = GraphArena(samples)
-        tot_nodes = int(arena.ns.sum())
-        tot_edges = int(arena.es.sum())
-        n_pad, e_pad, fallback = self._bucket_shape(tot_nodes, tot_edges)
-        batch = arena.collate(
-            np.arange(len(samples)),
-            num_nodes_pad=n_pad,
-            num_edges_pad=e_pad,
-            num_graphs_pad=self._g_pad,
-            edge_dim=self._edge_dim,
-        )
+        # "pack bin" stage of the correlation trail: this span names every
+        # request collated into the bin (docs/OBSERVABILITY.md).
+        with telemetry.span(
+            "serve/collate", request_ids=[r.request_id for r in entries]
+        ):
+            samples = [r.sample for r in entries]
+            arena = GraphArena(samples)
+            tot_nodes = int(arena.ns.sum())
+            tot_edges = int(arena.es.sum())
+            n_pad, e_pad, fallback = self._bucket_shape(tot_nodes, tot_edges)
+            batch = arena.collate(
+                np.arange(len(samples)),
+                num_nodes_pad=n_pad,
+                num_edges_pad=e_pad,
+                num_graphs_pad=self._g_pad,
+                edge_dim=self._edge_dim,
+            )
         self.metrics.observe("collate", time.perf_counter() - t0)
         self.metrics.record_batch(
             len(entries), self.max_batch_graphs, tot_nodes, n_pad,
@@ -623,8 +674,11 @@ class InferenceEngine:
         import jax
 
         t0 = time.perf_counter()
-        dev = jax.device_put(work.batch)
-        jax.block_until_ready(dev)
+        with telemetry.span(
+            "serve/h2d", request_ids=[r.request_id for r in work.requests]
+        ):
+            dev = jax.device_put(work.batch)
+            jax.block_until_ready(dev)
         self.metrics.observe("h2d", time.perf_counter() - t0)
         self.metrics.count(
             "h2d_bytes_total",
@@ -680,6 +734,9 @@ class InferenceEngine:
         return [np.asarray(o) for o in outputs]
 
     def _dispatch_loop(self) -> None:
+        # Explicit context handoff: the dispatcher's device spans parent to
+        # this incarnation's pipeline context (docs/OBSERVABILITY.md).
+        telemetry.attach(self._pipeline_ctx)
         try:
             # The batcher's shutdown marker ends the feed iteration; every
             # batch flushed before it is still executed and resolved here.
@@ -689,7 +746,11 @@ class InferenceEngine:
                 # _fail: the device's health is engine-scoped. Resolution
                 # failures (per-request slicing/denormalization) are
                 # BATCH-scoped: fail this batch's futures, keep serving.
-                outputs = self._execute(dev_batch)
+                with telemetry.span(
+                    "serve/device",
+                    request_ids=[r.request_id for r in work.requests],
+                ):
+                    outputs = self._execute(dev_batch)
                 try:
                     self._resolve(work, outputs)
                 except Exception as e:  # noqa: BLE001 — batch-scoped
@@ -697,8 +758,10 @@ class InferenceEngine:
                         self._reject(req, e)
                     self.metrics.count("errors_total")
                     self.metrics.count("bad_batches_total")
-                    with self._lock:
-                        self._degraded = True
+                    self._mark_degraded(
+                        "resolution_failure",
+                        [r.request_id for r in work.requests],
+                    )
         except BaseException as e:  # noqa: BLE001 — re-raised at callers
             self._fail(e)
 
@@ -722,6 +785,9 @@ class InferenceEngine:
                 # fails; batch-mates and the engine are unaffected.
                 self.metrics.count("nonfinite_total")
                 batch_had_nonfinite = True
+                telemetry.event(
+                    "serve/nonfinite", request_id=req.request_id
+                )
                 self._reject(
                     req,
                     NonFiniteOutputError(
@@ -733,10 +799,23 @@ class InferenceEngine:
                 self._pending.discard(req.future)
             req.future.set_result(per_head)
             self.metrics.observe("e2e", now - req.t_submit)
+            # Demux complete: the end of the correlation trail
+            # (submit → pack bin → device batch → demux → response).
+            telemetry.event(
+                "serve/response",
+                request_id=req.request_id,
+                e2e_s=round(now - req.t_submit, 6),
+            )
         if batch_had_nonfinite:
             self.metrics.count("bad_batches_total")
-            with self._lock:
-                self._degraded = True
+            self._mark_degraded(
+                "nonfinite_output",
+                [
+                    r.request_id
+                    for r in work.requests
+                    if r.future._error is not None
+                ],
+            )
 
     def _denormalize(self, ihead: int, value: np.ndarray) -> np.ndarray:
         if self._y_minmax is None:
@@ -744,6 +823,32 @@ class InferenceEngine:
         ymin = np.asarray(self._y_minmax[ihead][0])
         ymax = np.asarray(self._y_minmax[ihead][1])
         return value * (ymax - ymin) + ymin
+
+    def _mark_degraded(self, reason: str, request_ids: Sequence[str] = ()) -> None:
+        """Sticky health downgrade + a bounded transition log: /healthz
+        shows WHY the engine grayed out and which correlation ids were
+        involved, and the transition lands in the telemetry stream (so a
+        flight-recorder dump carries it too)."""
+        entry = {
+            "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "reason": reason,
+            "request_ids": [r for r in request_ids if r][:8],
+        }
+        with self._lock:
+            self._degraded = True
+            self._degraded_events.append(entry)
+        telemetry.event(
+            "serve/degraded",
+            reason=reason,
+            request_ids=entry["request_ids"],
+        )
+
+    @property
+    def degraded_events(self) -> List[dict]:
+        """Locked copy of the recent degraded-state transitions (newest
+        last) — the /healthz payload's ``degraded_events`` field."""
+        with self._lock:
+            return list(self._degraded_events)
 
     def _reject(self, req: _Request, exc: BaseException) -> None:
         with self._lock:
@@ -776,6 +881,13 @@ class InferenceEngine:
             with self._lock:
                 self._error = exc
             self._closing.set()
+            # Flight-recorder trigger (docs/OBSERVABILITY.md): the last
+            # thing operators get from a poisoned engine is the timeline
+            # that killed it.
+            telemetry.event("serve/engine_poisoned", error=repr(exc))
+            telemetry.flight_dump(
+                "engine_poison", extra={"error": repr(exc)}
+            )
         # Tear down this incarnation's pipeline either way: stop the batcher
         # FIRST (a stale batcher racing a successor on the shared queue would
         # strand whatever it popped), then cancel + join the feed threads.
@@ -802,7 +914,18 @@ class InferenceEngine:
                 self._degraded = True
                 self._feed = None
                 self._dispatcher = None
+                self._degraded_events.append(
+                    {
+                        "ts_utc": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                        "reason": "worker_restart",
+                        "request_ids": [],
+                        "error": repr(exc),
+                    }
+                )
             self.metrics.count("engine_restarts_total")
+            telemetry.event("serve/engine_restart", error=repr(exc))
             self.start()
 
     # -------------------------------------------------------------- warmup

@@ -24,7 +24,11 @@ from typing import List, Optional
 import numpy as np
 
 from ..graphs.sample import GraphSample
+from ..telemetry import graftel as telemetry
+from ..telemetry import render_prometheus
 from .engine import BackpressureError, EngineFailedError, InferenceEngine
+
+REQUEST_ID_HEADER = "X-HydraGNN-Request-Id"
 
 
 def parse_graph(doc: dict) -> GraphSample:
@@ -69,11 +73,40 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self.server, "verbose", False):
             super().log_message(fmt, *args)
 
+    def _request_id(self) -> str:
+        """This request's correlation id — echoed on EVERY response path
+        (200/400/404/429/5xx — docs/OBSERVABILITY.md)."""
+        rid = getattr(self, "_rid", None)
+        return rid if rid is not None else self._begin_request()
+
+    # Caller-supplied ids are reflected into response headers, telemetry
+    # records, /healthz payloads, and flight dumps: restrict to a safe
+    # charset and length so a crafted header (CRLF folds = response-header
+    # injection; megabyte values = ring/artifact bloat) is REPLACED by a
+    # generated id rather than echoed.
+    _RID_SAFE = frozenset(
+        "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789.-_/"
+    )
+    _RID_MAX_LEN = 64
+
+    def _begin_request(self) -> str:
+        """Per-request id (re)set — handler instances persist across
+        keep-alive requests, so the id must NOT be cached beyond one
+        request; honors a well-formed caller header, generates otherwise."""
+        raw = self.headers.get(REQUEST_ID_HEADER) or ""
+        ok = (
+            0 < len(raw) <= self._RID_MAX_LEN
+            and all(c in self._RID_SAFE for c in raw)
+        )
+        self._rid = raw if ok else telemetry.new_request_id()
+        return self._rid
+
     def _send_json(self, code: int, payload: dict, headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        self.send_header(REQUEST_ID_HEADER, self._request_id())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -84,11 +117,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        self.send_header(REQUEST_ID_HEADER, self._request_id())
         self.end_headers()
         self.wfile.write(body)
 
     # ---------------------------------------------------------------- routes
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        self._begin_request()
         if self.path == "/healthz":
             engine = self.engine
             # Three health states instead of the old binary: ok (200),
@@ -102,6 +137,9 @@ class _Handler(BaseHTTPRequestHandler):
                 {
                     "ok": engine.running,
                     "degraded": engine.degraded,
+                    # Recent degraded transitions with the correlation ids
+                    # that tripped them (docs/OBSERVABILITY.md).
+                    "degraded_events": engine.degraded_events,
                     "queue_depth": engine._queue.qsize(),
                     "queue_limit": engine.queue_limit,
                     "compiled_buckets": engine.compiled_buckets,
@@ -111,15 +149,20 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/metrics":
+            # Engine-scoped serving metrics + the process-wide graftel
+            # registry (timer totals, fault counters, training gauges when
+            # this process also trains) — one scrape, one registry.
             self._send_text(
                 200,
-                self.engine.metrics.render_prometheus(),
+                self.engine.metrics.render_prometheus()
+                + render_prometheus(),
                 "text/plain; version=0.0.4",
             )
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):  # noqa: N802
+        rid = self._begin_request()
         # Always drain the body first: HTTP/1.1 keep-alive would otherwise
         # parse leftover body bytes as the NEXT request line after a 404.
         length = int(self.headers.get("Content-Length", "0"))
@@ -134,34 +177,43 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError('body must be {"graphs": [<graph>, ...]}')
             samples = [parse_graph(g) for g in graphs_doc]
         except (ValueError, TypeError, json.JSONDecodeError) as e:
-            self._send_json(400, {"error": str(e)})
+            self._send_json(400, {"error": str(e), "request_id": rid})
             return
 
         engine = self.engine
         try:
             results = engine.predict(
-                samples, timeout=getattr(self.server, "request_timeout_s", 60.0)
+                samples,
+                timeout=getattr(self.server, "request_timeout_s", 60.0),
+                request_id=rid,
             )
         except BackpressureError as e:
             self._send_json(
                 429,
-                {"error": str(e), "retry_after_s": e.retry_after_s},
+                {
+                    "error": str(e),
+                    "retry_after_s": e.retry_after_s,
+                    "request_id": rid,
+                },
                 headers={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
             )
             return
         except (ValueError, TypeError) as e:  # per-graph validation
-            self._send_json(400, {"error": str(e)})
+            self._send_json(400, {"error": str(e), "request_id": rid})
             return
         except TimeoutError as e:
-            self._send_json(504, {"error": str(e)})
+            self._send_json(504, {"error": str(e), "request_id": rid})
             return
         except (EngineFailedError, RuntimeError) as e:
-            self._send_json(503, {"error": str(e)})
+            # NonFiniteOutputError lands here too (RuntimeError subclass):
+            # the failing request's 503 still carries its correlation id.
+            self._send_json(503, {"error": str(e), "request_id": rid})
             return
 
         self._send_json(
             200,
             {
+                "request_id": rid,
                 "heads": [
                     {"name": name, "type": htype, "dim": int(dim)}
                     for name, htype, dim in zip(

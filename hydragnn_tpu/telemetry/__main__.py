@@ -1,0 +1,166 @@
+"""graftel CLI: traced-train smoke + artifact validation.
+
+``python -m hydragnn_tpu.telemetry smoke [--out DIR]``
+    Run a 2-epoch traced synthetic train (CPU-safe, seconds), export the
+    JSONL event log and the Chrome trace, round-trip a flight-recorder dump,
+    and schema-validate all three. Exit 1 on any empty or invalid artifact —
+    the CI smoke step (.github/workflows/static-analysis.yml).
+
+``python -m hydragnn_tpu.telemetry validate <path>``
+    Schema-validate an existing artifact (``*.jsonl`` event log,
+    ``flightrec_*.json`` dump, or Chrome-trace JSON by sniffing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+from . import (
+    export_chrome_trace,
+    export_events_jsonl,
+    flight_dump,
+    span_counts,
+    validate_chrome_trace,
+    validate_events_jsonl,
+    validate_flight_file,
+)
+from . import configure as telemetry_configure
+
+
+def _smoke_train(epochs: int = 2) -> None:
+    """Tiny deterministic SAGE run through the REAL epoch driver — the spans
+    the exporters must carry come from the production pipeline wiring."""
+    import numpy as np
+
+    from ..graphs.sample import GraphSample
+    from ..models import create_model, init_model_variables
+    from ..preprocess.dataloader import GraphDataLoader
+    from ..train.train_validate_test import TrainingDriver
+    from ..train.trainer import create_train_state
+    from ..utils.optimizer import select_optimizer
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(8):
+        n = 6
+        x = rng.normal(size=(n, 1)).astype(np.float32)
+        senders = np.repeat(np.arange(n), 2)
+        receivers = (senders + 1 + np.arange(senders.size) % (n - 1)) % n
+        samples.append(
+            GraphSample(
+                x=x,
+                pos=rng.random((n, 3)).astype(np.float32),
+                y=np.array([x.sum()], np.float32),
+                y_loc=np.array([[0, 1]], np.int64),
+                edge_index=np.stack([senders, receivers]).astype(np.int64),
+            )
+        )
+    loader = GraphDataLoader(samples, batch_size=4, shuffle=False)
+    loader.set_head_spec(("graph",), (1,))
+    heads = {
+        "graph": {
+            "num_sharedlayers": 1,
+            "dim_sharedlayers": 4,
+            "num_headlayers": 1,
+            "dim_headlayers": [4],
+        }
+    }
+    model = create_model("SAGE", 1, 8, (1,), ("graph",), heads, [1.0], 2)
+    batch = next(iter(loader))
+    variables = init_model_variables(model, batch)
+    opt = select_optimizer("AdamW", 1e-3)
+    state = create_train_state(model, variables, opt)
+    driver = TrainingDriver(model, opt, state)
+    for _ in range(epochs):
+        driver.train_epoch(loader)
+    driver.evaluate(loader)
+
+
+def smoke(out_dir: str | None = None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    tmp_ctx = None
+    if out_dir is None:
+        tmp_ctx = tempfile.TemporaryDirectory(prefix="graftel_smoke_")
+        out_dir = tmp_ctx.name
+    os.makedirs(out_dir, exist_ok=True)
+    telemetry_configure(run_dir=out_dir, collect=True)
+    failures = []
+    try:
+        _smoke_train()
+
+        jsonl_path = os.path.join(out_dir, "trace_events.jsonl")
+        n_events = export_events_jsonl(jsonl_path)
+        count, errors = validate_events_jsonl(jsonl_path)
+        if count == 0:
+            failures.append("JSONL event log is empty")
+        failures.extend(f"jsonl: {e}" for e in errors)
+
+        chrome_path = os.path.join(out_dir, "trace_chrome.json")
+        export_chrome_trace(chrome_path)
+        failures.extend(
+            f"chrome: {e}" for e in validate_chrome_trace(chrome_path)
+        )
+
+        dump_path = flight_dump("smoke")
+        if dump_path is None:
+            failures.append("flight_dump returned no path")
+        else:
+            failures.extend(
+                f"flight: {e}" for e in validate_flight_file(dump_path)
+            )
+
+        counts = span_counts()
+        for required in ("train_epoch", "collate", "device_step"):
+            if not counts.get(required):
+                failures.append(f"no '{required}' spans in the trace")
+        print(
+            json.dumps(
+                {
+                    "ok": not failures,
+                    "events": n_events,
+                    "span_counts": counts,
+                    "failures": failures,
+                }
+            )
+        )
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+    return 1 if failures else 0
+
+
+def validate(path: str) -> int:
+    if path.endswith(".jsonl"):
+        count, errors = validate_events_jsonl(path)
+        ok = count > 0 and not errors
+    else:
+        with open(path) as f:
+            head = f.read(4096)
+        if '"traceEvents"' in head:
+            errors = validate_chrome_trace(path)
+        else:
+            errors = validate_flight_file(path)
+        ok = not errors
+    print(json.dumps({"ok": ok, "path": path, "errors": errors}))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m hydragnn_tpu.telemetry")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("smoke", help="2-epoch traced train + validation")
+    sp.add_argument("--out", default=None, help="artifact dir (default: tmp)")
+    vp = sub.add_parser("validate", help="schema-validate one artifact")
+    vp.add_argument("path")
+    args = ap.parse_args(argv)
+    if args.cmd == "smoke":
+        return smoke(args.out)
+    return validate(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""graftel exporters: JSONL event log + Chrome-trace (Perfetto-loadable)
+JSON, plus the schema validators the tier-1 tests, ``bench.py --trace``, and
+the CI smoke step share (docs/OBSERVABILITY.md "Exporter formats").
+
+JSONL: line 1 is a header record (``kind: "header"``, schema tag, pid,
+trace id); every following line is one span/event record exactly as graftel
+recorded it. Chrome trace: the standard ``{"traceEvents": [...]}`` object —
+complete ``"X"`` duration events in microseconds plus per-thread ``"M"``
+thread_name metadata — which chrome://tracing and ui.perfetto.dev load
+directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import graftel
+
+_RECORD_KINDS = ("span", "event")
+
+
+def _records(records: Optional[List[dict]]) -> List[dict]:
+    """Explicit records, else the collect buffer, else the ring — so a
+    ring-only run can still be exported (bounded window, clearly enough for
+    the short traced runs the exporters target)."""
+    if records is not None:
+        return records
+    collected = graftel.collected_records()
+    return collected if collected else graftel.snapshot_records()
+
+
+def export_events_jsonl(
+    path: str, records: Optional[List[dict]] = None
+) -> int:
+    """Write the JSONL event log; returns the number of data records."""
+    recs = _records(records)
+    header = {
+        "kind": "header",
+        "schema": graftel.SCHEMA_EVENTS,
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pid": os.getpid(),
+        "records": len(recs),
+    }
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for rec in recs:
+            f.write(json.dumps(rec, default=str) + "\n")
+    os.replace(tmp, path)
+    return len(recs)
+
+
+def _tid(thread_name: str, table: Dict[str, int]) -> int:
+    tid = table.get(thread_name)
+    if tid is None:
+        tid = table[thread_name] = len(table) + 1
+    return tid
+
+
+def export_chrome_trace(
+    path: str, records: Optional[List[dict]] = None
+) -> int:
+    """Write a Chrome-trace JSON of the spans/events; returns the number of
+    trace events (excluding thread-name metadata)."""
+    recs = _records(records)
+    pid = os.getpid()
+    tids: Dict[str, int] = {}
+    events = []
+    for rec in recs:
+        args = dict(rec.get("attrs") or {})
+        for k in ("request_id", "span_id", "parent_id"):
+            if rec.get(k):
+                args[k] = rec[k]
+        base = {
+            "name": rec.get("name", "?"),
+            "pid": pid,
+            "tid": _tid(rec.get("thread", "?"), tids),
+            "ts": float(rec.get("ts", 0.0)) * 1e6,
+            "args": args,
+        }
+        if rec.get("kind") == "span":
+            base["ph"] = "X"
+            base["dur"] = max(float(rec.get("dur_s", 0.0)) * 1e6, 0.01)
+        else:
+            base["ph"] = "i"
+            base["s"] = "t"
+        events.append(base)
+    meta = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
+        }
+        for tname, tid in tids.items()
+    ]
+    doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, default=str)
+    os.replace(tmp, path)
+    return len(events)
+
+
+# ------------------------------------------------------------------ validators
+def validate_record(rec: dict) -> List[str]:
+    """Schema errors of one span/event record ([] when valid)."""
+    errors = []
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    kind = rec.get("kind")
+    if kind not in _RECORD_KINDS:
+        return [f"bad kind {kind!r}"]
+    for key, typ in (
+        ("name", str),
+        ("ts", (int, float)),
+        ("thread", str),
+        ("trace_id", str),
+        ("span_id", str),
+    ):
+        if not isinstance(rec.get(key), typ):
+            errors.append(f"{kind} missing/invalid {key!r}")
+    if kind == "span" and not isinstance(rec.get("dur_s"), (int, float)):
+        errors.append("span missing/invalid 'dur_s'")
+    return errors
+
+
+def validate_events_jsonl(path: str) -> Tuple[int, List[str]]:
+    """(record count, schema errors) of a JSONL event log. A valid log has a
+    schema-tagged header line and >= 0 valid records; emptiness is the
+    CALLER's check (the CI smoke asserts non-empty)."""
+    errors: List[str] = []
+    count = 0
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        return 0, [f"unreadable: {e}"]
+    if not lines:
+        return 0, ["empty file (no header line)"]
+    try:
+        header = json.loads(lines[0])
+    except ValueError as e:
+        return 0, [f"header line is not JSON: {e}"]
+    if header.get("kind") != "header" or header.get("schema") != graftel.SCHEMA_EVENTS:
+        errors.append(
+            f"bad header (kind={header.get('kind')!r}, "
+            f"schema={header.get('schema')!r})"
+        )
+    for i, line in enumerate(lines[1:], start=2):
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {i}: not JSON ({e})")
+            continue
+        errors.extend(f"line {i}: {e}" for e in validate_record(rec))
+        count += 1
+    return count, errors
+
+
+def validate_flight(doc: dict) -> List[str]:
+    """Schema errors of one flight-recorder dump document."""
+    errors = []
+    if not isinstance(doc, dict):
+        return ["dump is not an object"]
+    if doc.get("schema") != graftel.SCHEMA_FLIGHT:
+        errors.append(f"bad schema tag {doc.get('schema')!r}")
+    for key, typ in (
+        ("trigger", str),
+        ("ts_utc", str),
+        ("pid", int),
+        ("seq", int),
+        ("records", list),
+        ("counters", dict),
+        ("gauges", dict),
+    ):
+        if not isinstance(doc.get(key), typ):
+            errors.append(f"missing/invalid {key!r}")
+    for i, rec in enumerate(doc.get("records") or []):
+        errors.extend(f"records[{i}]: {e}" for e in validate_record(rec))
+    return errors
+
+
+def validate_flight_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable: {e}"]
+    return validate_flight(doc)
+
+
+def validate_chrome_trace(path: str) -> List[str]:
+    """Loads the Chrome-trace JSON back and checks the event structure —
+    the "Perfetto export loads back" half of the tier-1 coverage."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable: {e}"]
+    errors = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing traceEvents list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "ph" not in ev or "pid" not in ev:
+            errors.append(f"traceEvents[{i}]: missing ph/pid")
+            continue
+        if ev["ph"] == "X" and not isinstance(ev.get("dur"), (int, float)):
+            errors.append(f"traceEvents[{i}]: X event without dur")
+        if ev["ph"] != "M" and not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"traceEvents[{i}]: event without ts")
+    return errors
+
+
+def span_counts(records: Optional[List[dict]] = None) -> Dict[str, int]:
+    """{record name: count} over the span/event stream — the per-layer span
+    census ``bench.py --trace`` embeds in TRACE_rNN.json."""
+    out: Dict[str, int] = {}
+    for rec in _records(records):
+        name = rec.get("name", "?")
+        out[name] = out.get(name, 0) + 1
+    return out

@@ -39,6 +39,7 @@ import time
 from typing import Callable, Iterable, Optional
 
 from ..analysis import tsan
+from ..telemetry import graftel as telemetry
 
 
 def transfer_error_is_transient(e: BaseException) -> bool:
@@ -101,12 +102,17 @@ class _Prefetcher:
 
     _SENTINEL = object()
 
-    def __init__(self, iterable: Iterable, depth: int = 8):
+    def __init__(self, iterable: Iterable, depth: int = 8, ctx=None):
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._err = None
         self._cancel = threading.Event()
 
         def _run():
+            # Explicit telemetry context handoff (docs/OBSERVABILITY.md):
+            # spans opened by the stage callable on THIS thread parent to the
+            # epoch/pipeline span the consumer captured — thread-locals alone
+            # cannot cross the stage boundary.
+            telemetry.attach(ctx)
             try:
                 for item in iterable:
                     while not self._cancel.is_set():
@@ -205,7 +211,13 @@ class FeedStats:
             self.h2d_bytes += int(nbytes)
             self.h2d_s += seconds
             self.h2d_transfers += 1
+            idx = self.h2d_transfers
             tsan.shared_access("FeedStats.fields")
+        # graftel emitter (docs/OBSERVABILITY.md): the transfer thread's wire
+        # time becomes a retroactive "h2d" span, parented to the epoch
+        # context the DeviceFeed attached to this thread — the flight
+        # recorder's per-batch H2D timeline.
+        telemetry.record_span("h2d", seconds, index=idx, bytes=int(nbytes))
 
     def credit(self, field: str, seconds: float) -> None:
         """Add consumer-side seconds to ``feed_wait_s``/``step_s`` (the
@@ -249,16 +261,22 @@ class DeviceFeed:
         device_depth: int = 2,
         transfer_retries: int = 2,
         transfer_backoff_s: float = 0.05,
+        ctx=None,
     ):
         if transfer is not None and transfer_retries > 0:
             transfer = with_transfer_retries(
                 transfer, retries=transfer_retries, backoff_s=transfer_backoff_s
             )
-        self._host = _Prefetcher(iterable, depth=host_depth)
+        # ``ctx`` is the caller's telemetry context (the epoch / serve
+        # pipeline span): handed EXPLICITLY to both stage threads so their
+        # spans parent to it (docs/OBSERVABILITY.md "context handoff").
+        self._host = _Prefetcher(iterable, depth=host_depth, ctx=ctx)
         self._dev = (
             None
             if transfer is None
-            else _Prefetcher(map(transfer, self._host), depth=device_depth)
+            else _Prefetcher(
+                map(transfer, self._host), depth=device_depth, ctx=ctx
+            )
         )
 
     def close(self):
@@ -282,6 +300,23 @@ class DeviceFeed:
             yield from src
         finally:
             self.close()
+
+
+def traced_batches(iterable: Iterable, name: str = "collate"):
+    """Wrap a batch source so each pull becomes a graftel span (the host
+    collation timeline of the flight recorder). Runs wherever the iterable
+    is consumed — on the DeviceFeed host thread for the pipelined paths — so
+    the spans parent to the context that thread attached."""
+    it = iter(iterable)
+    i = 0
+    while True:
+        with telemetry.span(name, index=i):
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+        yield b
+        i += 1
 
 
 class timed_consume:

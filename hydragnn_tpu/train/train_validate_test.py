@@ -21,10 +21,17 @@ import numpy as np
 from ..graphs.batch import GraphBatch
 from ..models.base import HydraGNN
 from ..utils.optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
+from ..telemetry import graftel as telemetry
 from ..utils.print_utils import iterate_tqdm, print_distributed
 from ..utils.profile import Profiler
 from ..utils.time_utils import Timer
-from .pipeline import DeviceFeed, FeedStats, _Prefetcher, timed_consume  # noqa: F401  (_Prefetcher re-exported for compat)
+from .pipeline import (  # noqa: F401  (_Prefetcher re-exported for compat)
+    DeviceFeed,
+    FeedStats,
+    _Prefetcher,
+    timed_consume,
+    traced_batches,
+)
 from .trainer import (
     TrainState,
     _batch_pspec,
@@ -311,47 +318,60 @@ class TrainingDriver:
             # Epoch-start last-good snapshot: the rollback target (taken
             # before the donating step can consume these buffers).
             self.guard.begin_epoch(self)
-        # Scan path only when nothing needs per-step host hooks.
-        if self.mesh is None and not (profiler and profiler.active):
-            return self._train_epoch_scan(loader)
-        metrics = EpochMetrics()
-        prof = profiler or Profiler()
-        # Two-stage device feed: collation thread -> transfer thread
-        # (device_put with the step's placement) -> this consumer. Batch k+1
-        # is committed device memory while step k executes.
-        batches = DeviceFeed(
-            self._device_groups(self._wrap_faults(loader))
-            if self.mesh is not None
-            else self._wrap_faults(iter(loader)),
-            transfer=lambda b: self._put_timed(b, prof),
-        )
-        batch_iter = iter(iterate_tqdm(batches, self.verbosity))
-        try:
-            while True:
-                # "feed" covers batch ACQUISITION (the device-queue wait —
-                # where an input-bound pipeline actually stalls); collation,
-                # the multi-host lift, and the H2D transfer all already
-                # happened on the pipeline threads.
-                with prof.annotate("feed"), timed_consume(
-                    self.feed_stats, "feed_wait_s"
-                ):
-                    batch = next(batch_iter, None)
-                if batch is None:
-                    break
-                with prof.annotate("train_step"), timed_consume(
-                    self.feed_stats, "step_s"
-                ):
-                    self.state, m = self.train_step(self.state, batch, self.rng)
-                    metrics.update(m)
-                if self.guard is not None:
-                    self.guard.after_update(self, m)
-                if profiler:
-                    profiler.step()
-        finally:
-            self._drain_feed(batches, "train")
-        return metrics.averages()
+        # The epoch-level telemetry span: its context is handed to the
+        # DeviceFeed threads so collate/h2d spans parent here (the
+        # flight-recorder timeline a guard-trip dump carries).
+        with telemetry.span(
+            "train_epoch", epoch=getattr(loader, "epoch", None)
+        ) as ep:
+            # Scan path only when nothing needs per-step host hooks.
+            if self.mesh is None and not (profiler and profiler.active):
+                return self._train_epoch_scan(loader, ep.ctx)
+            metrics = EpochMetrics()
+            prof = profiler or Profiler()
+            # Two-stage device feed: collation thread -> transfer thread
+            # (device_put with the step's placement) -> this consumer. Batch
+            # k+1 is committed device memory while step k executes.
+            batches = DeviceFeed(
+                self._device_groups(
+                    traced_batches(self._wrap_faults(loader))
+                )
+                if self.mesh is not None
+                else traced_batches(self._wrap_faults(iter(loader))),
+                transfer=lambda b: self._put_timed(b, prof),
+                ctx=ep.ctx,
+            )
+            batch_iter = iter(iterate_tqdm(batches, self.verbosity))
+            bi = 0
+            try:
+                while True:
+                    # "feed" covers batch ACQUISITION (the device-queue wait
+                    # — where an input-bound pipeline actually stalls);
+                    # collation, the multi-host lift, and the H2D transfer
+                    # all already happened on the pipeline threads.
+                    with prof.annotate("feed"), timed_consume(
+                        self.feed_stats, "feed_wait_s"
+                    ):
+                        batch = next(batch_iter, None)
+                    if batch is None:
+                        break
+                    with prof.annotate("train_step"), telemetry.span(
+                        "device_step", index=bi
+                    ), timed_consume(self.feed_stats, "step_s"):
+                        self.state, m = self.train_step(
+                            self.state, batch, self.rng
+                        )
+                        metrics.update(m)
+                    bi += 1
+                    if self.guard is not None:
+                        self.guard.after_update(self, m)
+                    if profiler:
+                        profiler.step()
+            finally:
+                self._drain_feed(batches, "train")
+            return metrics.averages()
 
-    def _train_epoch_scan(self, loader):
+    def _train_epoch_scan(self, loader, ctx=None):
         """Whole-epoch lax.scan in fixed-size chunks, buffered per batch shape
         (bucketed loaders emit a handful of static shapes). Chunk sizes repeat
         across epochs (loader length is constant), so compiles stay bounded:
@@ -398,7 +418,9 @@ class TrainingDriver:
             with sentinel:
                 for ci in rng.permutation(len(cached["chunks"])):
                     single, payload = cached["chunks"][ci]
-                    with timed_consume(self.feed_stats, "step_s"):
+                    with telemetry.span(
+                        "device_step", index=int(ci), cached=True
+                    ), timed_consume(self.feed_stats, "step_s"):
                         if single:
                             self.state, m = self.train_step(
                                 self.state, payload, self.rng
@@ -442,10 +464,13 @@ class TrainingDriver:
             self._host_chunks(loader),
             transfer=self._put_chunk,
             device_depth=1,
+            ctx=ctx,
         )
         try:
-            for single, payload in feed:
-                sink = self._run_scan_chunk(single, payload, metrics, sink)
+            for ci, (single, payload) in enumerate(feed):
+                sink = self._run_scan_chunk(
+                    single, payload, metrics, sink, index=ci
+                )
         finally:
             self._drain_feed(feed, "train")
         if cacheable:
@@ -466,7 +491,9 @@ class TrainingDriver:
         ``(single, host payload)``. Runs on the pipeline's host thread, so
         numpy stacking also overlaps device compute."""
         bufs: dict = {}
-        for b in self._wrap_faults(iterate_tqdm(loader, self.verbosity)):
+        for b in traced_batches(
+            self._wrap_faults(iterate_tqdm(loader, self.verbosity))
+        ):
             buf = bufs.setdefault(self._shape_key(b), [])
             buf.append(b)
             if len(buf) == self.scan_chunk:
@@ -482,14 +509,18 @@ class TrainingDriver:
             return True, batches[0]
         return False, stack_batches(batches, len(batches))
 
-    def _run_scan_chunk(self, single, payload, metrics, sink: Optional[dict]):
+    def _run_scan_chunk(
+        self, single, payload, metrics, sink: Optional[dict], index: int = 0
+    ):
         """Dispatch one device-resident chunk; when ``sink`` is given, retain
         THE SAME device copy for the reshuffle="batch" cache — the pipeline
         already transferred it, so the cache-building epoch performs exactly
         one host->device transfer per chunk. Returns None instead once the
         byte budget is exceeded; ``sink`` carries a running byte total so the
         first (timed) epoch's bookkeeping stays O(1) per chunk."""
-        with timed_consume(self.feed_stats, "step_s"):
+        with telemetry.span(
+            "device_step", index=index, chunk=not single
+        ), timed_consume(self.feed_stats, "step_s"):
             if single:
                 self.state, m = self.train_step(self.state, payload, self.rng)
             else:
@@ -511,6 +542,10 @@ class TrainingDriver:
         """validate()/test() analog. With return_values, also gathers per-head
         (true, predicted) arrays over real rows (test(), reference
         train_validate_test.py:267-304)."""
+        with telemetry.span("evaluate") as ep:
+            return self._evaluate(loader, return_values, profiler, ep.ctx)
+
+    def _evaluate(self, loader, return_values, profiler, ctx=None):
         self.feed_stats.reset()
         prof = profiler or Profiler()
         metrics = EpochMetrics()
@@ -554,10 +589,10 @@ class TrainingDriver:
             del self._eval_cache[id(loader)]
             cached = None
         if cached is not None and cached.get("batches") is not None:
-            for host_b, dev_b in cached["batches"]:
-                with prof.annotate("eval_step"), timed_consume(
-                    self.feed_stats, "step_s"
-                ):
+            for ei, (host_b, dev_b) in enumerate(cached["batches"]):
+                with prof.annotate("eval_step"), telemetry.span(
+                    "eval_step", index=ei, cached=True
+                ), timed_consume(self.feed_stats, "step_s"):
                     m, outputs = self.eval_step(self.state, dev_b)
                     metrics.update(m)
                 if return_values:
@@ -579,12 +614,13 @@ class TrainingDriver:
             batches = DeviceFeed(
                 self._device_groups(loader) if self.mesh is not None else iter(loader),
                 transfer=lambda b: (b, self._put_timed(b, prof)),
+                ctx=ctx,
             )
             try:
-                for batch, dev_b in batches:
-                    with prof.annotate("eval_step"), timed_consume(
-                        self.feed_stats, "step_s"
-                    ):
+                for ei, (batch, dev_b) in enumerate(batches):
+                    with prof.annotate("eval_step"), telemetry.span(
+                        "eval_step", index=ei
+                    ), timed_consume(self.feed_stats, "step_s"):
                         m, outputs = self.eval_step(self.state, dev_b)
                         metrics.update(m)
                     if return_values:
@@ -658,6 +694,11 @@ def train_validate_test(
     }
     timer = Timer("train_validate_test")
     timer.start()
+    # Cross-layer telemetry (docs/OBSERVABILITY.md): XLA compiles fold into
+    # the graftel registry (jax/compiles, jax/compile_s), and each epoch
+    # publishes its step/h2d/feed-wait/compile split as hydragnn_train_*
+    # Prometheus gauges — the training analog of the serve /metrics surface.
+    telemetry.install_jax_hooks()
     # Async checkpointing (docs/CHECKPOINTING.md): periodic saves snapshot
     # device→host on this thread and hand serialize/fsync/rename to a single
     # background writer — the epoch loop stalls for the snapshot only. The
@@ -677,9 +718,32 @@ def train_validate_test(
             if profiler:
                 profiler.set_current_epoch(epoch)
 
+            compile_s0 = telemetry.counter_value("jax/compile_s")
+            t_epoch0 = time.perf_counter()
             train_loss, train_rmses = driver.train_epoch(train_loader, profiler)
+            train_wall_s = time.perf_counter() - t_epoch0
+            train_split = driver.feed_stats.as_dict()
             val_loss, val_rmses = driver.evaluate(val_loader, profiler=profiler)
             test_loss, test_rmses = driver.evaluate(test_loader, profiler=profiler)
+
+            # Per-epoch training gauges (rendered by telemetry.
+            # render_prometheus; served by /metrics in a co-resident serve
+            # process, dumped to logs/<name>/train_metrics.prom at run end).
+            telemetry.gauge("train/epoch", epoch)
+            telemetry.gauge("train/epoch_wall_s", round(train_wall_s, 4))
+            telemetry.gauge("train/step_s_per_epoch", train_split["step_s"])
+            telemetry.gauge("train/h2d_s_per_epoch", train_split["h2d_s"])
+            telemetry.gauge(
+                "train/h2d_mb_per_epoch",
+                round(train_split["h2d_bytes"] / (1 << 20), 4),
+            )
+            telemetry.gauge(
+                "train/feed_wait_s_per_epoch", train_split["feed_wait_s"]
+            )
+            telemetry.gauge(
+                "train/compile_s_epoch",
+                round(telemetry.counter_value("jax/compile_s") - compile_s0, 4),
+            )
 
             if scheduler is not None:
                 current_lr = get_learning_rate(driver.state.opt_state)
@@ -763,6 +827,11 @@ def train_validate_test(
                     )
                     stall = time.perf_counter() - t0
                 Timer.credit("ckpt_save_stall", stall)
+                telemetry.event(
+                    "train/checkpoint_saved",
+                    epoch=epoch + 1,
+                    stall_s=round(stall, 4),
+                )
     finally:
         if checkpointer is not None:
             # Run-exit wait barrier: every queued write lands before the run

@@ -217,23 +217,33 @@ def _step_body(model: HydraGNN, optimizer, guard: bool = False):
 def make_train_step(
     model: HydraGNN, optimizer, donate: bool = True, guard: bool = False
 ) -> Callable:
+    body = _step_body(model, optimizer, guard)
+
     # donate_argnums: params/opt_state buffers are reused in place, halving
     # HBM traffic for the state update (callers must drop the old state).
-    return jax.jit(
-        _step_body(model, optimizer, guard), donate_argnums=(0,) if donate else ()
-    )
+    def step(state: TrainState, batch: GraphBatch, rng):
+        # The compiled-step half of the graftel trace bridge
+        # (docs/OBSERVABILITY.md): a named scope is pure op metadata — the
+        # emitted computation is numerically identical — but XLA carries it
+        # into the profiler, so a captured Perfetto trace shows device ops
+        # under the same name the host-side telemetry spans use.
+        with jax.named_scope("hydragnn.train_step"):
+            return body(state, batch, rng)
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(model: HydraGNN) -> Callable:
     @jax.jit
     def step(state: TrainState, batch: GraphBatch):
-        outputs = _apply_model(
-            model, state.params, state.batch_stats, batch, train=False
-        )
-        loss, rmses = multihead_rmse_loss(
-            outputs, batch, model.output_type, model.task_weights
-        )
-        count = batch.count_real_graphs().astype(jnp.float32)
+        with jax.named_scope("hydragnn.eval_step"):
+            outputs = _apply_model(
+                model, state.params, state.batch_stats, batch, train=False
+            )
+            loss, rmses = multihead_rmse_loss(
+                outputs, batch, model.output_type, model.task_weights
+            )
+            count = batch.count_real_graphs().astype(jnp.float32)
         return (
             {"loss": loss * count, "rmses": rmses * count, "count": count},
             outputs,
@@ -258,9 +268,12 @@ def make_train_epoch_scan(
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def epoch(state: TrainState, batches: GraphBatch, rng):
-        state, metrics = jax.lax.scan(
-            lambda s, b: body(s, b, rng), state, batches
-        )
+        # Trace-annotation bridge: same metadata-only scope as
+        # make_train_step, so scanned epochs attribute identically.
+        with jax.named_scope("hydragnn.train_epoch_scan"):
+            state, metrics = jax.lax.scan(
+                lambda s, b: body(s, b, rng), state, batches
+            )
         return state, jax.tree_util.tree_map(
             lambda m: jnp.sum(m, axis=0), metrics
         )

@@ -7,27 +7,25 @@ all-gather via multihost_utils instead of torch.distributed reduce."""
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict
 
 import jax
 
-from ..analysis import tsan
+from ..telemetry import graftel as telemetry
 
 
 class Timer:
     """Accumulating named timer; class-level registry like the reference.
 
-    The registry is written from the main thread (start/stop pairs) AND from
-    the pipeline/serve worker threads (``credit`` — the transfer thread's H2D
-    wire time, every ``serve_*`` stage). Unlocked, two concurrent credits to
-    the same name lose one update; the class lock closes that (graftrace
-    ``unguarded-shared-write``)."""
-
-    _totals: Dict[str, float] = {}  # guarded-by: Timer._lock
-    _counts: Dict[str, int] = {}  # guarded-by: Timer._lock
-    _lock = tsan.instrument_lock(threading.Lock(), "Timer._lock")
+    Since the graftel PR the STORAGE lives in the process-wide telemetry
+    registry (telemetry/graftel.py, one lock for every metric surface) under
+    ``timer/<name>`` keys — written from the main thread (start/stop pairs)
+    AND from the pipeline/serve worker threads (``credit`` — the transfer
+    thread's H2D wire time, every ``serve_*`` stage). ``Timer`` keeps its
+    historical API as the reporting surface (``print_timers``,
+    ``reduce_timers``), but it is now a graftel emitter: bench.py, the serve
+    ``/metrics`` exposition, and the timer report all read one registry."""
 
     def __init__(self, name: str):
         self.name = name
@@ -42,11 +40,7 @@ class Timer:
         if self._start is None:
             raise RuntimeError(f"Timer {self.name} not started")
         elapsed = time.perf_counter() - self._start
-        with Timer._lock:
-            Timer._totals[self.name] = (
-                Timer._totals.get(self.name, 0.0) + elapsed
-            )
-            Timer._counts[self.name] = Timer._counts.get(self.name, 0) + 1
+        telemetry.timer_credit(self.name, elapsed)
         self._start = None
         return elapsed
 
@@ -65,23 +59,17 @@ class Timer:
         hold a start/stop Timer across threads)."""
         if seconds <= 0:
             return
-        with cls._lock:
-            cls._totals[name] = cls._totals.get(name, 0.0) + seconds
-            cls._counts[name] = cls._counts.get(name, 0) + 1
-            tsan.shared_access("Timer.registry")
+        telemetry.timer_credit(name, seconds)
 
     @classmethod
     def snapshot(cls) -> Dict[str, float]:
         """Locked copy of the totals — every reader outside the class goes
         through this (reporting must not see a mid-update registry)."""
-        with cls._lock:
-            return dict(cls._totals)
+        return telemetry.timer_totals()
 
     @classmethod
     def reset(cls):
-        with cls._lock:
-            cls._totals.clear()
-            cls._counts.clear()
+        telemetry.clear_counters("timer/")
 
 
 def reduce_timers() -> Dict[str, Dict[str, float]]:

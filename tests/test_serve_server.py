@@ -83,7 +83,7 @@ def pytest_serve_http_predict_healthz_metrics_end_to_end():
         # Serving seconds surface in the shared Timer registry too.
         from hydragnn_tpu.utils.time_utils import Timer
 
-        assert Timer._totals.get("serve_e2e", 0.0) > 0.0
+        assert Timer.snapshot().get("serve_e2e", 0.0) > 0.0
     finally:
         server.shutdown()
 

@@ -1,0 +1,511 @@
+"""graftel (hydragnn_tpu/telemetry/) — unified tracing, flight recorder,
+and cross-layer telemetry (docs/OBSERVABILITY.md). Tier-1, CPU.
+
+Covers the acceptance criteria of the graftel PR: a serve request's
+correlation id traceable HTTP ingress → pack bin → device batch → demux →
+response header; a deliberately injected ``nan_grad@K`` drill producing a
+flight-recorder dump whose span timeline includes the offending step's
+collate/h2d/device spans; dump triggers for engine poisoning, checkpoint
+fallback, and supervisor restarts (each schema-validated); and the JSONL +
+Chrome-trace (Perfetto) exporters of a short traced train run loading back.
+"""
+
+import glob
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as ge
+from hydragnn_tpu import telemetry
+from hydragnn_tpu.faults import FaultCounters, FaultPlan
+from hydragnn_tpu.graphs import collate_graphs
+from hydragnn_tpu.graphs.sample import GraphSample
+from hydragnn_tpu.models import create_model, init_model_variables
+from hydragnn_tpu.preprocess.dataloader import GraphDataLoader
+from hydragnn_tpu.serve import InferenceEngine, InferenceServer
+from hydragnn_tpu.train.train_validate_test import TrainingDriver
+from hydragnn_tpu.train.trainer import create_train_state
+from hydragnn_tpu.utils.optimizer import select_optimizer
+from hydragnn_tpu.utils.time_utils import Timer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Process-global tracer: every test starts from module defaults and
+    leaves no run_dir/collect state behind for unrelated suites."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+HEADS = {
+    "graph": {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 4,
+        "num_headlayers": 1,
+        "dim_headlayers": [4],
+    },
+}
+
+
+def _dataset(rng, count=12, lo=4, hi=10):
+    graphs = []
+    for _ in range(count):
+        n = int(rng.integers(lo, hi))
+        x = rng.normal(size=(n, 1)).astype(np.float32)
+        ei = np.stack([np.arange(n), (np.arange(n) + 1) % n]).astype(np.int32)
+        graphs.append(
+            GraphSample(
+                x=x, pos=np.zeros((n, 3), np.float32),
+                y=np.array([x.sum()], np.float32),
+                y_loc=np.array([[0, 1]], np.int64), edge_index=ei,
+            )
+        )
+    return graphs
+
+
+def _loader(graphs, **kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("shuffle", False)
+    loader = GraphDataLoader(graphs, **kw)
+    loader.set_head_spec(("graph",), (1,))
+    return loader
+
+
+def _driver_for(loader, ft=None, plan=None):
+    model = create_model("SAGE", 1, 8, (1,), ("graph",), HEADS, [1.0], 2)
+    variables = init_model_variables(model, next(iter(loader)))
+    opt = select_optimizer("AdamW", 5e-3)
+    state = create_train_state(model, variables, opt)
+    return TrainingDriver(model, opt, state, fault_tolerance=ft, fault_plan=plan)
+
+
+def _serve_engine(**options):
+    rng = np.random.default_rng(3)
+    graphs = ge._make_graphs(6, rng)
+    model = ge._build_model(hidden=8, layers=2)
+    batch = collate_graphs(graphs[:2], ge.TYPES, ge.DIMS, edge_dim=1)
+    variables = init_model_variables(model, batch)
+    options.setdefault("max_batch_graphs", 4)
+    options.setdefault("max_delay_ms", 10.0)
+    return InferenceEngine(model, variables, **options), graphs
+
+
+# ----------------------------------------------------------- span primitives
+def pytest_span_nesting_and_cross_thread_handoff():
+    """Same-thread nesting parents via the thread-local stack; cross-thread
+    propagation requires the EXPLICIT handoff (capture ctx, attach on the
+    receiving thread) — a bare thread sees no parent."""
+    telemetry.configure(collect=True)
+    with telemetry.span("outer") as outer:
+        with telemetry.span("inner"):
+            pass
+        captured = outer.ctx
+
+        seen = {}
+
+        def bare():
+            seen["bare"] = telemetry.current()
+            with telemetry.span("on-thread-bare"):
+                pass
+
+        def handed():
+            telemetry.attach(captured)
+            seen["handed"] = telemetry.current()
+            with telemetry.span("on-thread-handed"):
+                pass
+
+        for fn in (bare, handed):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join(10)
+
+    recs = {r["name"]: r for r in telemetry.collected_records()}
+    assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+    assert seen["bare"] is None
+    assert recs["on-thread-bare"]["parent_id"] is None
+    assert seen["handed"] is captured
+    assert recs["on-thread-handed"]["parent_id"] == captured.span_id
+    # Request ids inherit down the context chain.
+    with telemetry.span("req-root", request_id="r-abc"):
+        with telemetry.span("req-child"):
+            pass
+    recs = {r["name"]: r for r in telemetry.collected_records()}
+    assert recs["req-child"]["request_id"] == "r-abc"
+
+
+def pytest_ring_bounded_and_flight_dump_schema(tmp_path):
+    """The flight recorder is a bounded window: flooding it never grows
+    memory, and a dump is schema-valid with the trigger + registry
+    snapshot."""
+    telemetry.configure(run_dir=str(tmp_path))
+    for i in range(5000):
+        telemetry.event("flood", i=i)
+    assert len(telemetry.snapshot_records()) <= 4096
+    telemetry.counter("drill/things", 3)
+    telemetry.gauge("drill/level", 0.5)
+    path = telemetry.flight_dump("unit_drill", extra={"k": "v"})
+    assert path is not None and os.path.exists(path)
+    assert telemetry.validate_flight_file(path) == []
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "unit_drill"
+    assert doc["extra"] == {"k": "v"}
+    assert doc["counters"]["drill/things"] == 3
+    assert doc["gauges"]["drill/level"] == 0.5
+    # No configured/explicit run dir -> silent no-op, not an exception.
+    telemetry.configure(run_dir=None)
+    telemetry.reset()
+    assert telemetry.flight_dump("nowhere") is None
+
+
+def pytest_one_registry_for_timer_faultcounters_prometheus():
+    """The retrofit claim: Timer and FaultCounters STORE into the graftel
+    registry, and render_prometheus exposes the same numbers (training
+    gauges included)."""
+    Timer.reset()
+    FaultCounters.reset()
+    Timer.credit("unit_phase", 1.5)
+    FaultCounters.inc("unit_faults", 2)
+    telemetry.gauge("train/step_s_per_epoch", 0.25)
+    assert telemetry.counters_snapshot("timer/")["timer/unit_phase"] == 1.5
+    assert telemetry.counters_snapshot("fault/")["fault/unit_faults"] == 2
+    assert Timer.snapshot()["unit_phase"] == 1.5
+    assert FaultCounters.get("unit_faults") == 2
+    text = telemetry.render_prometheus()
+    assert "hydragnn_timer_unit_phase_total 1.5" in text
+    assert "hydragnn_fault_unit_faults_total 2" in text
+    assert "hydragnn_train_step_s_per_epoch 0.25" in text
+    # FaultCounters increments also land in the event stream (the flight
+    # recorder shows WHICH survival mechanism fired).
+    names = [r["name"] for r in telemetry.snapshot_records()]
+    assert "fault/unit_faults" in names
+    Timer.reset()
+    FaultCounters.reset()
+    assert Timer.snapshot() == {}
+    assert FaultCounters.snapshot() == {}
+
+
+def pytest_disabled_tracer_keeps_registry_but_drops_records():
+    telemetry.configure(enabled=False, collect=True)
+    with telemetry.span("dropped"):
+        pass
+    telemetry.event("dropped-too")
+    Timer.credit("still_counted", 1.0)
+    assert telemetry.collected_records() == []
+    assert telemetry.snapshot_records() == []
+    assert Timer.snapshot()["still_counted"] == 1.0
+
+
+# ------------------------------------------------- flight-recorder triggers
+def pytest_nan_grad_drill_dump_has_offending_step_spans(tmp_path):
+    """ACCEPTANCE: a deliberately injected ``nan_grad@2`` drill trips the
+    non-finite guard, and the flight-recorder dump's span timeline includes
+    the offending step's collate/h2d/device spans."""
+    telemetry.configure(run_dir=str(tmp_path))
+    rng = np.random.default_rng(0)
+    loader = _loader(_dataset(rng))
+    d = _driver_for(
+        loader,
+        ft={"enabled": True, "max_bad_steps": 99},
+        plan=FaultPlan("nan_grad@2"),
+    )
+    d.scan_chunk = 1  # per-batch dispatch: span indices == fed batch indices
+    d.train_epoch(loader)
+    dumps = glob.glob(str(tmp_path / "flightrec_*_guard_trip.json"))
+    assert len(dumps) == 1, "one dump per bad streak"
+    assert telemetry.validate_flight_file(dumps[0]) == []
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["extra"]["bad_steps_this_update"] == 1
+    spans = [r for r in doc["records"] if r["kind"] == "span"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # The offending step (fed batch 2) end to end: its collation span, its
+    # H2D transfer, and its device dispatch are all in the timeline.
+    assert any(s["attrs"]["index"] == 2 for s in by_name["collate"])
+    assert any(s["attrs"]["index"] == 2 for s in by_name["device_step"])
+    assert len(by_name["h2d"]) >= 3  # batches 0..2 all transferred
+    # The guard's own counter event made it into the same timeline.
+    assert any(r["name"] == "fault/bad_steps" for r in doc["records"])
+    # All three pipeline stages hang off ONE (still-open at dump time) epoch
+    # span: the collate/h2d spans were emitted on the feed-host and
+    # feed-transfer threads yet share the consumer-thread device_step
+    # spans' parent via the explicit context handoff.
+    epoch_parent = {s.get("parent_id") for s in by_name["device_step"]}
+    assert len(epoch_parent) == 1 and None not in epoch_parent
+    assert {s.get("parent_id") for s in by_name["collate"]} == epoch_parent
+    assert {s.get("parent_id") for s in by_name["h2d"]} == epoch_parent
+
+
+def pytest_engine_poison_dumps_flight_recorder(tmp_path):
+    telemetry.configure(run_dir=str(tmp_path))
+    engine, graphs = _serve_engine()
+
+    def boom(dev_batch):
+        raise RuntimeError("injected device failure")
+
+    engine._execute = boom
+    fut = engine.submit(graphs[0])
+    with pytest.raises(RuntimeError, match="injected device failure"):
+        fut.result(timeout=30.0)
+    engine.close()
+    dumps = glob.glob(str(tmp_path / "flightrec_*_engine_poison.json"))
+    assert len(dumps) == 1
+    assert telemetry.validate_flight_file(dumps[0]) == []
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert "injected device failure" in doc["extra"]["error"]
+    # The poisoned request's submit event is in the timeline, correlated.
+    rid = fut.request_id
+    assert any(
+        r["name"] == "serve/submit" and r.get("request_id") == rid
+        for r in doc["records"]
+    )
+
+
+def pytest_checkpoint_fallback_dumps_flight_recorder(tmp_path):
+    from hydragnn_tpu.utils.model import load_existing_model, save_model
+
+    telemetry.configure(run_dir=str(tmp_path))  # NOT used: dump goes to run_dir arg
+    params = {"dense": {"kernel": np.arange(12, dtype=np.float32).reshape(4, 3)}}
+    variables = {"params": params, "batch_stats": {}}
+    opt = select_optimizer("AdamW", 1e-3)
+    opt_state = opt.init(params)
+    for epoch in (1, 2, 3):
+        save_model(
+            variables, opt_state, "fb", path=str(tmp_path) + "/",
+            meta={"epoch": epoch}, keep_last_k=3,
+        )
+    ckpt = str(tmp_path / "fb" / "fb.pk")
+    with open(ckpt, "r+b") as f:
+        f.seek(120)
+        b = f.read(1)
+        f.seek(120)
+        f.write(bytes([b[0] ^ 0xFF]))
+    template = {
+        "params": {"dense": {"kernel": np.zeros((4, 3), np.float32)}},
+        "batch_stats": {},
+    }
+    _, _, meta = load_existing_model(
+        template, "fb", path=str(tmp_path) + "/", return_meta=True
+    )
+    assert meta["epoch"] == 2
+    dumps = glob.glob(
+        str(tmp_path / "fb" / "flightrec_*_checkpoint_fallback.json")
+    )
+    assert len(dumps) == 1
+    assert telemetry.validate_flight_file(dumps[0]) == []
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["extra"]["fallback_file"] == "fb.e000002.pk"
+    assert doc["extra"]["epochs_lost"] == 1
+
+
+def pytest_supervisor_restart_dumps_flight_recorder(tmp_path, monkeypatch):
+    """The restart trigger without real child processes: fake the child
+    subprocess (rc=1 then rc=0) and assert the parent dumped its timeline
+    into the run dir on the restart."""
+    from hydragnn_tpu.faults import supervisor
+
+    rcs = iter([1, 0])
+
+    class _Proc:
+        def __init__(self, rc):
+            self.returncode = rc
+
+    monkeypatch.setattr(
+        supervisor.subprocess,
+        "run",
+        lambda *a, **kw: _Proc(next(rcs)),
+    )
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "SAGE",
+                "radius": 2,
+                "max_neighbours": 10,
+                "num_conv_layers": 2,
+                "hidden_dim": 8,
+                "task_weights": [1.0],
+            },
+            "Training": {
+                "num_epoch": 1,
+                "learning_rate": 0.001,
+                "batch_size": 4,
+            },
+            "Variables_of_interest": {"input_node_features": [0]},
+        },
+        "Dataset": {"name": "sup_tele"},
+    }
+    meta = supervisor.run_supervised(
+        config, max_restarts=2, logs_path=str(tmp_path) + "/"
+    )
+    assert meta["completed"] and meta["restarts"] == 1
+    run_dir = os.path.join(str(tmp_path), meta["log_name"])
+    dumps = glob.glob(
+        os.path.join(run_dir, "flightrec_*_supervisor_restart.json")
+    )
+    assert len(dumps) == 1
+    assert telemetry.validate_flight_file(dumps[0]) == []
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["extra"]["attempt"] == 1 and doc["extra"]["returncode"] == 1
+    assert any(
+        r["name"] == "fault/supervisor_restart" for r in doc["records"]
+    )
+
+
+# ----------------------------------------------- serve correlation, HTTP e2e
+def pytest_serve_correlation_id_traceable_end_to_end():
+    """ACCEPTANCE: the correlation id flows HTTP ingress → submit → pack bin
+    (collate span) → device batch (device span) → demux (response event) →
+    X-HydraGNN-Request-Id response header; the 429 path echoes it too."""
+    telemetry.configure(collect=True)
+    engine, graphs = _serve_engine()
+    server = InferenceServer(engine, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        body = json.dumps(
+            {
+                "graphs": [
+                    {
+                        "x": np.asarray(graphs[0].x).tolist(),
+                        "edge_index": np.asarray(graphs[0].edge_index).tolist(),
+                        "edge_attr": np.asarray(graphs[0].edge_attr).tolist(),
+                    }
+                ]
+            }
+        ).encode()
+        req = urllib.request.Request(
+            base + "/predict",
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "X-HydraGNN-Request-Id": "r-e2e-test",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-HydraGNN-Request-Id"] == "r-e2e-test"
+            doc = json.loads(resp.read())
+        assert doc["request_id"] == "r-e2e-test"
+
+        # The per-graph id is <call id>/<index>; every stage of the trail
+        # carries it.
+        rid = "r-e2e-test/0"
+        recs = telemetry.collected_records()
+        submit = [r for r in recs if r["name"] == "serve/submit"]
+        assert any(r["request_id"] == rid for r in submit)
+        for stage in ("serve/collate", "serve/h2d", "serve/device"):
+            stage_recs = [r for r in recs if r["name"] == stage]
+            assert any(
+                rid in r["attrs"]["request_ids"] for r in stage_recs
+            ), f"{stage} lost the correlation id"
+        response = [r for r in recs if r["name"] == "serve/response"]
+        assert any(r["request_id"] == rid for r in response)
+
+        # Header present on GET paths too.
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            assert resp.headers["X-HydraGNN-Request-Id"]
+            health = json.loads(resp.read())
+        assert health["degraded_events"] == []
+        # /metrics carries the graftel registry next to the engine metrics.
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "hydragnn_serve_requests_total" in text
+        assert "hydragnn_timer_serve_e2e_total" in text
+    finally:
+        server.shutdown()
+
+
+def pytest_serve_429_echoes_request_id_and_healthz_logs_degraded():
+    engine, graphs = _serve_engine(queue_limit=1, autostart=False)
+    engine.submit(graphs[0])  # occupy the single queue slot
+    server = InferenceServer(engine, port=0, request_timeout_s=5.0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        body = json.dumps(
+            {"graphs": [{"x": np.asarray(graphs[1].x).tolist()}]}
+        ).encode()
+        req = urllib.request.Request(
+            base + "/predict",
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "X-HydraGNN-Request-Id": "r-shed-me",
+            },
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 429
+        assert e.value.headers["X-HydraGNN-Request-Id"] == "r-shed-me"
+        assert json.loads(e.value.read())["request_id"] == "r-shed-me"
+    finally:
+        server.shutdown()
+    # Degraded transitions carry the correlation ids that tripped them.
+    engine2, graphs2 = _serve_engine(max_delay_ms=5.0)
+    try:
+        real_collate = engine2._collate
+        calls = {"n": 0}
+
+        def flaky(entries):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("injected collation failure")
+            return real_collate(entries)
+
+        engine2._collate = flaky
+        fut = engine2.submit(graphs2[0], request_id="r-degrader")
+        with pytest.raises(ValueError):
+            fut.result(timeout=30.0)
+        events = engine2.degraded_events
+        assert events and events[-1]["reason"] == "collation_failure"
+        assert "r-degrader" in events[-1]["request_ids"]
+    finally:
+        engine2.close()
+
+
+# -------------------------------------------------------------- exporters
+def pytest_traced_train_exports_valid_jsonl_and_perfetto(tmp_path):
+    """A short traced train run exports a non-empty schema-valid JSONL event
+    log, and the Chrome-trace (Perfetto) export loads back."""
+    from hydragnn_tpu.telemetry.__main__ import _smoke_train
+
+    telemetry.configure(run_dir=str(tmp_path), collect=True)
+    _smoke_train(epochs=2)
+
+    jsonl = str(tmp_path / "trace_events.jsonl")
+    n = telemetry.export_events_jsonl(jsonl)
+    assert n > 0
+    count, errors = telemetry.validate_events_jsonl(jsonl)
+    assert count == n and errors == []
+
+    chrome = str(tmp_path / "trace_chrome.json")
+    n_events = telemetry.export_chrome_trace(chrome)
+    assert n_events == n
+    assert telemetry.validate_chrome_trace(chrome) == []
+    with open(chrome) as f:
+        doc = json.load(f)  # loads back as plain JSON
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"train_epoch", "collate", "device_step"} <= names
+    # thread_name metadata present for the pipeline threads.
+    threads = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert any(t.startswith("hydragnn-prefetch") for t in threads)
+
+    counts = telemetry.span_counts()
+    assert counts["train_epoch"] == 2
+    assert counts["device_step"] >= 2
