@@ -1,0 +1,220 @@
+"""graftcache cold-vs-warm A/B: the compile-wall elasticity measurement
+(docs/COMPILE_CACHE.md; ISSUE 10 acceptance gate).
+
+Three child PROCESSES build the identical serving engine (same model seed,
+same bucket ladder, one shared store directory) — process isolation is the
+point: a warm start must survive a full process death, which is what a
+supervisor restart or a new serve replica is.
+
+* **cold** — empty store: warmup pays the full per-rung compile wall and
+  serializes every executable back.
+* **warm** — same store, fresh process: warmup HYDRATES every rung
+  (deserialize, zero XLA compiles — the child asserts it with the recompile
+  sentinel) and then serves the same request set; outputs must be BIT-exact
+  against the cold arm's (the children print sha256 digests over the raw
+  output bytes).
+* **corrupt** — one entry bit-flipped on disk: the child's warmup falls back
+  to a fresh compile for that rung only (loud: ``exec_cache_corrupt``
+  fault counter, quarantined entry), the engine is NOT poisoned, and
+  outputs still match bit-exactly.
+
+The parent gates: ``warm_speedup = cold warmup wall / warm warmup wall``
+must be ≥ 5 (the ISSUE 10 acceptance floor), ``recompiles_after_warmup``
+must be 0 in the warm arm, and all three output digests must agree.
+
+    python benchmarks/compile_cache_ab.py [--json]
+    python benchmarks/compile_cache_ab.py --child '<json-spec>'   (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# The A/B's fixed engine shape: a few ladder rungs so the compile wall is a
+# real multi-executable warmup, tiny model so the whole drill stays in CI
+# budget on CPU.
+LADDER = [[96, 768], [160, 1280], [256, 2048]]
+REQUESTS = 6
+
+
+def _child(spec: dict) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from benchmarks.serve_load import build_serving_engine
+    from hydragnn_tpu import telemetry
+    from hydragnn_tpu.faults import FaultCounters
+
+    import numpy as np
+
+    timing: dict = {}
+    engine, graphs = build_serving_engine(
+        max_batch_graphs=4,
+        max_delay_ms=5.0,
+        pool_size=16,
+        bucket_ladder=[tuple(r) for r in spec["ladder"]],
+        compile_cache=spec["cache_dir"],
+        timing=timing,
+    )
+    warmup_compiles = timing["warmup_xla_compiles"]
+    buckets_after_warmup = engine.compiled_buckets
+    try:
+        # The same deterministic request set in every arm — the raw output
+        # bytes are the bit-exactness witness across processes.
+        digest = hashlib.sha256()
+        with engine.no_recompile(action="count") as watch:
+            for i in range(spec.get("requests", REQUESTS)):
+                outs = engine.predict([graphs[i % len(graphs)]])
+                for heads in outs:
+                    for arr in heads:
+                        digest.update(np.ascontiguousarray(arr).tobytes())
+        snap = engine.metrics.snapshot()["bucket_cache"]
+        return {
+            "warmup_wall_s": timing["warmup_wall_s"],
+            "warmup_xla_compiles": warmup_compiles,
+            "buckets_compiled": snap["misses"],
+            "buckets_hydrated": snap["hydrated"],
+            "compile_seconds": snap["compile_seconds"],
+            "hydrate_seconds": snap["hydrate_seconds"],
+            "cache_hits": snap["hits"],
+            "recompiles_after_warmup": engine.compiled_buckets
+            - buckets_after_warmup,
+            "xla_compiles_during_load": watch.count,
+            "exec_cache_corrupt": FaultCounters.snapshot().get(
+                "exec_cache_corrupt", 0
+            ),
+            "cache_counters": telemetry.counters_snapshot("cache/"),
+            "output_digest": digest.hexdigest(),
+            "engine_poisoned": not engine.running,
+        }
+    finally:
+        engine.close()
+
+
+def _spawn_arm(cache_dir: str, label: str) -> dict:
+    spec = {"cache_dir": cache_dir, "ladder": LADDER, "requests": REQUESTS}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks/compile_cache_ab.py"),
+            "--child",
+            json.dumps(spec),
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{label} arm child failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}"
+        )
+    line = [l for l in proc.stdout.splitlines() if l.startswith("CHILD ")][-1]
+    out = json.loads(line[len("CHILD ") :])
+    out["process_wall_s"] = round(time.perf_counter() - t0, 2)
+    out["arm"] = label
+    return out
+
+
+def _corrupt_one_entry(cache_dir: str) -> str:
+    from hydragnn_tpu.cache.store import ENTRY_SUFFIX
+
+    entries = sorted(
+        f for f in os.listdir(cache_dir) if f.endswith(ENTRY_SUFFIX)
+    )
+    target = os.path.join(cache_dir, entries[0])
+    with open(target, "rb") as f:
+        blob = bytearray(f.read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(target, "wb") as f:
+        f.write(bytes(blob))
+    return entries[0]
+
+
+def run_compile_cache_ab(cache_dir: "str | None" = None) -> dict:
+    """The full drill; returns the artifact block (see module docstring)."""
+    own_tmp = cache_dir is None
+    if own_tmp:
+        tmp = tempfile.TemporaryDirectory(prefix="graftcache_ab_")
+        cache_dir = tmp.name
+    try:
+        cold = _spawn_arm(cache_dir, "cold")
+        warm = _spawn_arm(cache_dir, "warm")
+        corrupted_entry = _corrupt_one_entry(cache_dir)
+        corrupt = _spawn_arm(cache_dir, "corrupt")
+    finally:
+        if own_tmp:
+            tmp.cleanup()
+
+    speedup = (
+        round(cold["warmup_wall_s"] / warm["warmup_wall_s"], 2)
+        if warm["warmup_wall_s"]
+        else None
+    )
+    ok = (
+        speedup is not None
+        and speedup >= 5.0
+        and warm["buckets_compiled"] == 0
+        and warm["buckets_hydrated"] == len(LADDER)
+        and warm["warmup_xla_compiles"] == 0
+        and warm["recompiles_after_warmup"] == 0
+        and warm["output_digest"] == cold["output_digest"]
+        # Corrupt arm: ONE rung recompiled fresh (loudly), the rest
+        # hydrated, outputs still bit-exact, engine alive.
+        and corrupt["exec_cache_corrupt"] >= 1
+        and corrupt["buckets_compiled"] == 1
+        and corrupt["buckets_hydrated"] == len(LADDER) - 1
+        and corrupt["output_digest"] == cold["output_digest"]
+        and not corrupt["engine_poisoned"]
+    )
+    return {
+        "metric": "compile_cache_warm_speedup",
+        "value": speedup or 0.0,
+        "unit": "x_cold_vs_warm_warmup_wall",
+        "gate": 5.0,
+        "ladder": LADDER,
+        "requests_per_arm": REQUESTS,
+        "recompiles_after_warmup": warm["recompiles_after_warmup"],
+        "bit_exact_warm_vs_cold": warm["output_digest"] == cold["output_digest"],
+        "corrupted_entry": corrupted_entry,
+        "corrupt_fallback_ok": bool(
+            corrupt["exec_cache_corrupt"] >= 1
+            and not corrupt["engine_poisoned"]
+            and corrupt["output_digest"] == cold["output_digest"]
+        ),
+        "cold": cold,
+        "warm": warm,
+        "corrupt": corrupt,
+        "ok": bool(ok),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--child", default=None, help="internal: child-arm spec JSON")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.child:
+        print("CHILD " + json.dumps(_child(json.loads(args.child))), flush=True)
+        return 0
+    block = run_compile_cache_ab()
+    print(json.dumps(block, indent=None if args.json else 2))
+    return 0 if block["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,10 +63,16 @@ def build_serving_engine(
     pool_size: int = 64,
     bucket_ladder=None,
     packing: bool = False,
+    compile_cache: "str | None" = None,
+    timing: "dict | None" = None,
 ):
     """Small flagship-family engine + a request-graph pool. Default ladder is
     the pool's worst-case single bucket (the historical / unpacked arm);
-    pass a fitted ``bucket_ladder`` (+ ``packing=True``) for the packed arm."""
+    pass a fitted ``bucket_ladder`` (+ ``packing=True``) for the packed arm.
+    ``compile_cache`` binds the graftcache store (docs/COMPILE_CACHE.md) so
+    warmup hydrates what a previous process compiled; ``timing`` (a dict, if
+    given) receives ``warmup_wall_s`` — the per-arm cold-vs-hydrated warmup
+    wall the serving artifact reports."""
     import __graft_entry__ as ge
     from hydragnn_tpu.graphs import collate_graphs
     from hydragnn_tpu.graphs.collate import compute_pad_sizes
@@ -90,9 +96,21 @@ def build_serving_engine(
         max_delay_ms=max_delay_ms,
         queue_limit=queue_limit,
         bucket_ladder=bucket_ladder,
-        warmup=True,
+        warmup=False,
         packing=packing,
+        compile_cache=compile_cache,
     )
+    from hydragnn_tpu.analysis.sentinel import compile_count
+
+    c0 = compile_count()
+    t0 = time.perf_counter()
+    engine.warmup()
+    if timing is not None:
+        timing["warmup_wall_s"] = round(time.perf_counter() - t0, 4)
+        # XLA compiles attributable to the warmup itself (NOT engine/model
+        # construction's small eager-op compiles): 0 on a fully hydrated
+        # store — the deserialized-executable-is-not-a-compile property.
+        timing["warmup_xla_compiles"] = compile_count() - c0
     return engine, graphs
 
 
@@ -209,7 +227,7 @@ def open_loop(
     }
 
 
-def _run_arm(engine, graphs, duration_s, loads, hist=None) -> dict:
+def _run_arm(engine, graphs, duration_s, loads, hist=None, timing=None) -> dict:
     """One engine through the full workload (closed + open sweep) under the
     recompile sentinel; returns the arm's measurement block."""
     warm_snap = engine.metrics.snapshot()["bucket_cache"]
@@ -229,9 +247,15 @@ def _run_arm(engine, graphs, duration_s, loads, hist=None) -> dict:
             "bucket_ladder": engine._ladder,
             "packing": engine._packing,
         },
+        # Per-arm warmup wall incl. the graftcache split: on a warm store
+        # the hydrated count replaces the compiled count and the wall drops
+        # from compile-seconds to deserialize-seconds (docs/COMPILE_CACHE.md).
         "warmup": {
             "buckets_compiled": warm_snap["misses"],
             "compile_seconds": warm_snap["compile_seconds"],
+            "buckets_hydrated": warm_snap["hydrated"],
+            "hydrate_seconds": warm_snap["hydrate_seconds"],
+            "wall_s": (timing or {}).get("warmup_wall_s"),
         },
         # Executable-cache growth since warmup — robust to the per-level
         # metrics-window resets above: any steady-state compile adds an
@@ -293,6 +317,7 @@ def run_serve_benchmark(
     out_path: "str | None" = None,
     ab: bool = True,
     max_rungs: int = 6,
+    compile_cache: "str | None" = None,
 ) -> dict:
     import jax
 
@@ -300,9 +325,14 @@ def run_serve_benchmark(
 
     hist = SizeHistogram()
     # Arm A — unpacked: the historical single worst-case bucket (SERVE_r06).
-    engine, graphs = build_serving_engine()
+    timing_a: dict = {}
+    engine, graphs = build_serving_engine(
+        compile_cache=compile_cache, timing=timing_a
+    )
     try:
-        unpacked = _run_arm(engine, graphs, duration_s, loads, hist=hist)
+        unpacked = _run_arm(
+            engine, graphs, duration_s, loads, hist=hist, timing=timing_a
+        )
     finally:
         engine.close()
 
@@ -334,9 +364,15 @@ def run_serve_benchmark(
     ladder = fit_ladder(hist, max_rungs=max_rungs)
 
     # Arm B — packed: fitted ladder + first-fit-decreasing flush packing.
-    engine, graphs = build_serving_engine(bucket_ladder=ladder, packing=True)
+    timing_b: dict = {}
+    engine, graphs = build_serving_engine(
+        bucket_ladder=ladder,
+        packing=True,
+        compile_cache=compile_cache,
+        timing=timing_b,
+    )
     try:
-        packed = _run_arm(engine, graphs, duration_s, loads)
+        packed = _run_arm(engine, graphs, duration_s, loads, timing=timing_b)
     finally:
         engine.close()
 
@@ -380,6 +416,13 @@ def main() -> int:
         help="single unpacked arm only (the pre-packing artifact shape)",
     )
     ap.add_argument("--max-rungs", type=int, default=6)
+    ap.add_argument(
+        "--compile-cache",
+        default=None,
+        metavar="DIR",
+        help="bind the graftcache executable store: a second run over the "
+        "same ladder warms up by hydration (per-arm warmup.wall_s shows it)",
+    )
     args = ap.parse_args()
     loads = tuple(float(v) for v in args.loads.split(",") if v.strip())
     block = run_serve_benchmark(
@@ -388,6 +431,7 @@ def main() -> int:
         out_path=args.out,
         ab=not args.no_ab,
         max_rungs=args.max_rungs,
+        compile_cache=args.compile_cache,
     )
     print(json.dumps(block))
     return 0

@@ -114,6 +114,53 @@ def _serve_drill() -> None:
         engine.close()
 
 
+def _cache_drill(tmpdir: str) -> None:
+    """graftcache path: two registries over ONE store directory (the
+    two-replicas-one-store topology), each hammered from its own thread —
+    compile+serialize races hydrate races manifest read-modify-write, all
+    under the instrumented ExecutableRegistry/ExecutableStore locks
+    (docs/COMPILE_CACHE.md; ISSUE 10 requires the store's locks registered
+    here from day one)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.cache import CacheKey, ExecutableRegistry, ExecutableStore
+
+    cache_dir = os.path.join(tmpdir, "graftcache")
+    fns = [
+        jax.jit(lambda x, k=k: x * (k + 1) + x.sum()) for k in range(2)
+    ]
+    x = jax.device_put(np.ones((8,), np.float32))
+
+    def worker(wid: int):
+        reg = ExecutableRegistry(ExecutableStore(cache_dir), name=f"drill{wid}")
+        for k, fn in enumerate(fns):
+            key = CacheKey.for_environment(
+                program=f"tsan_drill_{k}",
+                config_fingerprint="tsan-drill",
+                bucket=(8, 0, 0),
+            )
+            exe, _outcome, _s = reg.lookup_or_compile(
+                ("drill", k), key, lambda fn=fn: fn.lower(x)
+            )
+            exe(x)
+            len(reg)
+
+    threads = [
+        threading.Thread(
+            target=worker, args=(w,), name=f"cache-drill-{w}", daemon=True
+        )
+        for w in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    ExecutableStore(cache_dir).verify()
+
+
 def _telemetry_drill(tmpdir: str) -> None:
     """graftel path: concurrent spans/events/counters from worker threads
     racing a flight dump on the main thread — the tracer's single registry
@@ -154,6 +201,7 @@ def run_drill(seed: int) -> dict:
         _checkpoint_drill(tmpdir)
         _serve_drill()
         _telemetry_drill(tmpdir)
+        _cache_drill(tmpdir)
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

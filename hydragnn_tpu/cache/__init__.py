@@ -1,0 +1,46 @@
+"""graftcache — persistent compiled-executable store shared across runs,
+restarts, and replicas (docs/COMPILE_CACHE.md).
+
+The padded-arena contract compiles one executable per bucket shape, which
+makes compile wall the dominant cold-start cost: BENCH_r05_hw measured 51.8 s
+of bucketed serve warmup and 9.9 s of train compile, and every faults-layer
+supervisor restart and every new serve replica paid it again. This package
+makes those executables a durable artifact:
+
+* :class:`CacheKey` — the full environment+program fingerprint an entry is
+  keyed by: (jax/jaxlib version, backend + device-topology string, a config
+  fingerprint built on the checkpoint layer's param-tree fingerprint,
+  donation/guard flags, the padded bucket shape, and an argument-signature
+  digest). Any component mismatching is a MISS — a cache can never hand a
+  stale program to a changed environment.
+* :class:`ExecutableStore` — the on-disk half: one integrity-checked
+  container per entry (the checkpoint layer's digest + fsync'd atomic-rename
+  pattern), an advisory manifest, a keep-policy GC, and a LOUD corruption
+  fallback — a damaged entry is quarantined and recompiled fresh, never a
+  crash.
+* :class:`ExecutableRegistry` — the in-memory half the serve engine and the
+  trainer share: ONE locked lookup → (compile outside the lock) → store
+  path, with graftel ``cache/*`` counters and truthful sentinel accounting
+  (a deserialized executable fires no XLA compile event — verified).
+
+CLI: ``python -m hydragnn_tpu.cache ls|verify|gc <cache_dir>`` (mirrors the
+checkpoint CLI).
+"""
+
+from .store import (
+    CacheEntryError,
+    CacheKey,
+    ExecutableStore,
+    environment_fingerprint,
+    tree_signature,
+)
+from .registry import ExecutableRegistry
+
+__all__ = [
+    "CacheEntryError",
+    "CacheKey",
+    "ExecutableRegistry",
+    "ExecutableStore",
+    "environment_fingerprint",
+    "tree_signature",
+]

@@ -1,0 +1,85 @@
+"""graftcache operations CLI (docs/COMPILE_CACHE.md) — the checkpoint CLI's
+analog for the compiled-executable store::
+
+    python -m hydragnn_tpu.cache ls     <cache_dir> [--json]
+    python -m hydragnn_tpu.cache verify <cache_dir> [--json]
+    python -m hydragnn_tpu.cache gc     <cache_dir> [--keep-last K]
+                                        [--max-age-days D] [--json]
+
+``ls`` lists entries (program, bucket, backend, format, size) from the
+manifest merged with the directory truth; ``verify`` integrity-checks every
+entry container (exit nonzero if any fails) — the preflight before trusting
+a copied-around cache directory; ``gc`` applies the keep policy and sweeps
+quarantine/tmp litter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .store import ExecutableStore
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.cache",
+        description="Inspect, verify, or garbage-collect a graftcache "
+        "compiled-executable store.",
+    )
+    ap.add_argument("command", choices=("ls", "verify", "gc"))
+    ap.add_argument("cache_dir", help="store directory (e.g. logs/<name>/compile_cache)")
+    ap.add_argument("--keep-last", type=int, default=0,
+                    help="gc: keep only the newest K entries")
+    ap.add_argument("--max-age-days", type=float, default=None,
+                    help="gc: drop entries older than D days")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    store = ExecutableStore(args.cache_dir)
+
+    if args.command == "ls":
+        rows = store.ls()
+        if args.json:
+            print(json.dumps({"entries": rows}))
+        else:
+            for r in rows:
+                key = r.get("key") or {}
+                bucket = "x".join(str(v) for v in (key.get("bucket") or ()))
+                print(
+                    f"{r['digest'][:12]}  {key.get('program', '?'):<16} "
+                    f"bucket={bucket:<14} {key.get('backend', '?'):<5} "
+                    f"{r.get('exe_format', '?'):<9} {r.get('bytes', 0)} B  "
+                    f"{r.get('created_utc') or '-'}"
+                )
+            print(f"{len(rows)} entr{'y' if len(rows) == 1 else 'ies'}")
+        return 0
+
+    if args.command == "verify":
+        reports = store.verify()
+        bad = [r for r in reports if not r.get("ok")]
+        if args.json:
+            print(json.dumps({"reports": reports, "ok": not bad}))
+        else:
+            for r in reports:
+                status = (
+                    f"ok ({r.get('exe_format')}, {r.get('bytes')} B)"
+                    if r.get("ok")
+                    else f"CORRUPT: {r.get('error')}"
+                )
+                print(f"{r['file']}: {status}")
+        return 1 if bad else 0
+
+    removed = store.gc(keep_last=args.keep_last, max_age_days=args.max_age_days)
+    if args.json:
+        print(json.dumps({"removed": removed}))
+    else:
+        for digest in removed:
+            print(f"removed: {digest}")
+        print(f"{len(removed)} removed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

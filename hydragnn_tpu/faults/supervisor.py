@@ -63,12 +63,18 @@ def _write_meta(meta_path: str, meta: dict) -> dict:
 
 def _prepare_config(config: dict) -> dict:
     """Supervised copy of the config: resume from this run's own checkpoint on
-    every restart, and guarantee there IS a checkpoint to resume from."""
+    every restart, and guarantee there IS a checkpoint to resume from. The
+    graftcache executable store defaults ON under supervision (set
+    ``compile_cache: 0`` to opt out): a restarted incarnation re-pays the
+    whole compile wall otherwise, which is exactly the cold-start cost the
+    store exists to absorb (docs/COMPILE_CACHE.md)."""
     cfg = copy.deepcopy(config)
     tr = cfg["NeuralNetwork"]["Training"]
     tr["resume"] = 1
     if not tr.get("periodic_checkpoint_every"):
         tr["periodic_checkpoint_every"] = 1
+    if "compile_cache" not in tr:
+        tr["compile_cache"] = 1
     return cfg
 
 

@@ -234,6 +234,43 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
         from .faults import FaultPlan
 
         fault_plan = FaultPlan(training_cfg["faults"])
+    # graftcache (docs/COMPILE_CACHE.md): Training.compile_cache enables the
+    # persistent compiled-executable store — a string is the store directory
+    # (shareable across runs/replicas), any other truthy value defaults to
+    # logs/<name>/compile_cache. The config fingerprint half of every key is
+    # the digest of the completed Architecture + optimizer blocks, so a
+    # resumed/restarted run hydrates its own executables and a changed model
+    # or optimizer can never collide with them. The digest is computed
+    # UNCONDITIONALLY: a store enabled via HYDRAGNN_COMPILE_CACHE alone must
+    # carry the same key strength (optimizer hyperparameters like weight
+    # decay change the compiled program without changing any tree shape).
+    import hashlib
+
+    compile_cache_fp = hashlib.sha256(
+        json.dumps(
+            {
+                "architecture": config["NeuralNetwork"]["Architecture"],
+                "optimizer": training_cfg.get("optimizer"),
+            },
+            sort_keys=True,
+            default=str,
+        ).encode()
+    ).hexdigest()
+    if "compile_cache" in training_cfg:
+        cc = training_cfg["compile_cache"]
+        if not cc:
+            # An EXPLICIT falsy value is a hard opt-out (the supervisor
+            # documents `compile_cache: 0`) — it must also override an
+            # exported HYDRAGNN_COMPILE_CACHE ("" disables, None defers).
+            compile_cache_dir = ""
+        else:
+            compile_cache_dir = (
+                cc
+                if isinstance(cc, str)
+                else "./logs/" + log_name + "/compile_cache"
+            )
+    else:
+        compile_cache_dir = None  # defer to HYDRAGNN_COMPILE_CACHE
     driver = TrainingDriver(
         model,
         optimizer,
@@ -242,6 +279,8 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
         verbosity=verbosity,
         fault_tolerance=training_cfg.get("fault_tolerance"),
         fault_plan=fault_plan,
+        compile_cache=compile_cache_dir,
+        compile_cache_fingerprint=compile_cache_fp,
     )
 
     # Visualizer gets the test set's input node features and graph sizes

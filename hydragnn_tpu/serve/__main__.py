@@ -79,6 +79,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument(
+        "--compile-cache",
+        default=None,
+        metavar="DIR",
+        help="persistent compiled-executable store (graftcache, docs/"
+        "COMPILE_CACHE.md): warmup hydrates the ladder's executables "
+        "from DIR instead of recompiling — a restarted replica is warm "
+        "in seconds; fresh compiles are serialized back. Default: the "
+        "HYDRAGNN_COMPILE_CACHE env var (unset = no persistence)",
+    )
+    ap.add_argument(
         "--max-worker-restarts",
         type=int,
         default=1,
@@ -160,6 +170,7 @@ def main(argv=None) -> int:
         ladder_step=args.ladder_step,
         max_worker_restarts=args.max_worker_restarts,
         guard_outputs=not args.no_output_guard,
+        compile_cache=args.compile_cache,
     )
     server = InferenceServer(
         engine, host=args.host, port=args.port, verbose=args.verbose

@@ -41,7 +41,9 @@ checkpoint and graphs regardless of how requests are grouped into buckets
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import pickle
 import queue
 import threading
@@ -53,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..analysis import tsan
+from ..cache import CacheKey, ExecutableRegistry, ExecutableStore, tree_signature
 from ..graphs.collate import GraphArena, round_up_pow2
 from ..graphs.packing import PackCaps, first_fit_decreasing
 from ..graphs.sample import GraphSample
@@ -197,6 +200,15 @@ class InferenceEngine:
         (pending/queued requests fail, the engine goes ``degraded`` but keeps
         accepting traffic) instead of poisoning the engine. 0 = the
         historical binary poisoning.
+    compile_cache:
+        Optional graftcache directory (docs/COMPILE_CACHE.md). With it set,
+        ``warmup()`` and cache misses first try to HYDRATE the executable
+        from the persistent store (a verified deserialize — seconds, zero
+        XLA compiles) before paying a fresh compile, and fresh compiles are
+        serialized back, so a restarted or newly spun-up replica warms its
+        whole ladder from disk. ``None`` falls back to the
+        ``HYDRAGNN_COMPILE_CACHE`` env var; empty/unset disables
+        persistence (the historical in-memory-only cache).
     autostart:
         Tests set False to exercise queue behavior without worker threads;
         call :meth:`start` to launch them later.
@@ -219,6 +231,7 @@ class InferenceEngine:
         metrics: Optional[ServeMetrics] = None,
         guard_outputs: bool = True,
         max_worker_restarts: int = 0,
+        compile_cache: Optional[str] = None,
         autostart: bool = True,
     ):
         import jax
@@ -255,10 +268,35 @@ class InferenceEngine:
             threading.Lock(), "InferenceEngine._lock"
         )
         # Compiled-executable cache: filled by warmup() on the caller thread
-        # AND by cache misses on the dispatch thread. Lookups/stores hold the
-        # lock; the compile itself runs outside it (a 10-50 s lowering must
-        # not block submit()'s pending-set bookkeeping).
-        self._executables: Dict[Tuple[int, int, int], Any] = {}  # guarded-by: self._lock
+        # AND by cache misses on the dispatch thread — since the graftcache
+        # PR one shared ExecutableRegistry (cache/registry.py) whose single
+        # locked lookup→(compile outside the lock)→store path replaced the
+        # historical self._executables dict. With a compile_cache directory
+        # bound, misses hydrate from the persistent store before compiling
+        # fresh (docs/COMPILE_CACHE.md).
+        cache_dir = (
+            compile_cache
+            if compile_cache is not None
+            else os.environ.get("HYDRAGNN_COMPILE_CACHE", "")
+        )
+        self._registry = ExecutableRegistry(
+            ExecutableStore(cache_dir) if cache_dir else None, name="serve"
+        )
+        # The serve half of the persistent key: model/weights identity from
+        # the checkpoint layer's param-tree fingerprint plus the module's
+        # field repr (hyperparameters without parameters — activation,
+        # aggregation list — change the program but not the param tree).
+        self._config_fingerprint = ""
+        if self._registry.store is not None:
+            from ..checkpoint.format import param_fingerprint
+
+            self._config_fingerprint = hashlib.sha256(
+                (
+                    param_fingerprint(variables["params"])
+                    + param_fingerprint(variables.get("batch_stats", {}))
+                    + repr(model)
+                ).encode()
+            ).hexdigest()
 
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_limit)
         self._pending: set = set()  # guarded-by: self._lock
@@ -324,10 +362,9 @@ class InferenceEngine:
     @property
     def compiled_buckets(self) -> int:
         """Locked executable-cache size — /healthz and the serve CLI read
-        this cross-thread (graftrace's read check stops at ``self.X`` forms;
-        callers must not reach through ``engine._executables`` directly)."""
-        with self._lock:
-            return len(self._executables)
+        this cross-thread (the registry's len() holds its own lock;
+        callers must not reach through the registry's internals directly)."""
+        return len(self._registry)
 
     @property
     def degraded(self) -> bool:
@@ -689,24 +726,45 @@ class InferenceEngine:
         )
         return work, dev
 
+    def _cache_key(self, bucket: Tuple[int, int, int], batch) -> Optional[CacheKey]:
+        """Persistent-store key for one bucket shape, or None when no store
+        is bound (in-memory misses then skip the fingerprint arithmetic).
+        The args digest covers the FULL call signature (params, batch_stats,
+        batch) — host and device copies of a batch share shapes/dtypes, so
+        warmup (host dummy batch) and live traffic (device batch) agree."""
+        if self._registry.store is None:
+            return None
+        return CacheKey.for_environment(
+            program="serve_forward",
+            config_fingerprint=self._config_fingerprint,
+            flags=(),
+            bucket=bucket,
+            args_digest=tree_signature((self._params, self._bstats, batch)),
+        )
+
     def _executable_for(self, dev_batch):
         key = (
             dev_batch.num_nodes_pad,
             dev_batch.num_edges_pad,
             dev_batch.num_graphs_pad,
         )
-        with self._lock:
-            exe = self._executables.get(key)
-        if exe is None:
-            # Compile OUTSIDE the lock: a 10-50 s lowering must not block
-            # submit()'s pending-set bookkeeping or /healthz reads.
-            t0 = time.perf_counter()
-            exe = self._jit.lower(self._params, self._bstats, dev_batch).compile()
-            self.metrics.record_compile(time.perf_counter() - t0)
-            with self._lock:
-                self._executables[key] = exe
-        else:
+        # The registry's single lookup path: locked in-memory get; on miss
+        # (outside the lock — a 10-50 s lowering must not block submit()'s
+        # pending-set bookkeeping or /healthz reads) a persistent-store
+        # hydrate, then a fresh compile + store-back. The CacheKey closure
+        # is evaluated on misses only — steady-state hits never pay the
+        # param-tree fingerprint arithmetic.
+        exe, outcome, seconds = self._registry.lookup_or_compile(
+            key,
+            lambda: self._cache_key(key, dev_batch),
+            lambda: self._jit.lower(self._params, self._bstats, dev_batch),
+        )
+        if outcome == "memory":
             self.metrics.count("cache_hits_total")
+        elif outcome == "disk":
+            self.metrics.record_hydrate(seconds)
+        else:
+            self.metrics.record_compile(seconds)
         return exe
 
     def no_recompile(self, allow: int = 0, action: str = "raise"):
@@ -941,20 +999,25 @@ class InferenceEngine:
             )
         compiled = 0
         # Iterate the MERGED ladder: constructor-declared buckets still cold
-        # at this point must warm too, as the docstring promises.
+        # at this point must warm too, as the docstring promises. With a
+        # persistent store bound, a rung found on disk HYDRATES (seconds,
+        # zero XLA compiles — the replica-spin-up path docs/COMPILE_CACHE.md
+        # exists for) and does not count toward the compile total.
         for n_pad, e_pad in self._ladder:
             key = (int(n_pad), int(e_pad), self._g_pad)
-            with self._lock:
-                warm = key in self._executables
-            if warm:
+            if self._registry.get(key) is not None:
                 continue
             batch = self._dummy_batch(int(n_pad), int(e_pad))
-            t0 = time.perf_counter()
-            exe = self._jit.lower(self._params, self._bstats, batch).compile()
-            self.metrics.record_compile(time.perf_counter() - t0)
-            with self._lock:
-                self._executables[key] = exe
-            compiled += 1
+            _exe, outcome, seconds = self._registry.lookup_or_compile(
+                key,
+                self._cache_key(key, batch),
+                lambda b=batch: self._jit.lower(self._params, self._bstats, b),
+            )
+            if outcome == "disk":
+                self.metrics.record_hydrate(seconds)
+            elif outcome == "compiled":
+                self.metrics.record_compile(seconds)
+                compiled += 1
         return compiled
 
     def _dummy_batch(self, n_pad: int, e_pad: int):

@@ -148,6 +148,13 @@ class ServeMetrics:
         self.cache_misses_total = 0  # guarded-by: self._lock
         self.ladder_fallback_total = 0  # guarded-by: self._lock
         self.compile_seconds_total = 0.0  # guarded-by: self._lock
+        # Persistent executable cache (graftcache, docs/COMPILE_CACHE.md):
+        # disk hydrations — executables deserialized from the store instead
+        # of compiled. A hydration is NOT a compile (no XLA compile event)
+        # and NOT an in-memory hit; it gets its own pair so warmup cost is
+        # attributable (exported as hydragnn_serve_exec_cache_*).
+        self.exec_cache_hydrated_total = 0  # guarded-by: self._lock
+        self.exec_cache_hydrate_seconds_total = 0.0  # guarded-by: self._lock
         self.h2d_bytes_total = 0  # guarded-by: self._lock
         # Occupancy / padding accumulators (averages derived in snapshot()).
         self._occupancy_sum = 0.0  # guarded-by: self._lock
@@ -185,6 +192,14 @@ class ServeMetrics:
             self.cache_misses_total += 1
             self.compile_seconds_total += seconds
         Timer.credit("serve_compile", seconds)
+
+    def record_hydrate(self, seconds: float) -> None:
+        """One executable deserialized from the persistent store (a
+        graftcache disk hit — docs/COMPILE_CACHE.md)."""
+        with self._lock:
+            self.exec_cache_hydrated_total += 1
+            self.exec_cache_hydrate_seconds_total += seconds
+        Timer.credit("serve_exec_cache_hydrate", seconds)
 
     def record_request(self, num_nodes: int, num_edges: int) -> None:
         """One admitted request's graph size — the serve half of the size
@@ -236,6 +251,10 @@ class ServeMetrics:
                     "misses": self.cache_misses_total,
                     "compile_seconds": round(self.compile_seconds_total, 4),
                     "ladder_fallbacks": self.ladder_fallback_total,
+                    "hydrated": self.exec_cache_hydrated_total,
+                    "hydrate_seconds": round(
+                        self.exec_cache_hydrate_seconds_total, 4
+                    ),
                 },
                 "h2d_bytes_total": self.h2d_bytes_total,
                 "batch_occupancy_mean": round(
@@ -298,6 +317,14 @@ class ServeMetrics:
         ("cache_misses_total", "bucket_cache_misses_total"),
         ("ladder_fallback_total", "ladder_fallback_total"),
         ("compile_seconds_total", "compile_seconds_total"),
+        # graftcache exposition (docs/COMPILE_CACHE.md): the persistent
+        # executable store's view of this engine — hits/misses alias the
+        # bucket-cache pair (one registry serves both), hydrations are the
+        # disk-restore half only this family carries.
+        ("cache_hits_total", "exec_cache_hits_total"),
+        ("cache_misses_total", "exec_cache_misses_total"),
+        ("exec_cache_hydrated_total", "exec_cache_hydrated_total"),
+        ("exec_cache_hydrate_seconds_total", "exec_cache_hydrate_seconds_total"),
         ("h2d_bytes_total", "h2d_bytes_total"),
     )
 

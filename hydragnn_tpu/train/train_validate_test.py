@@ -81,6 +81,8 @@ class TrainingDriver:
         verbosity: int = 0,
         fault_tolerance: Optional[dict] = None,
         fault_plan=None,
+        compile_cache: Optional[str] = None,
+        compile_cache_fingerprint: str = "",
     ):
         from ..faults import FaultPlan, StepGuard
 
@@ -156,6 +158,50 @@ class TrainingDriver:
                 ),
                 donate_argnums=(0,),
             )
+        # Persistent compiled-executable store (graftcache, docs/
+        # COMPILE_CACHE.md): the single-device compiled steps (train_step /
+        # epoch_scan / perm_scan / eval_step) dispatch through the shared
+        # ExecutableRegistry — the same locked lookup→compile-outside-lock→
+        # store path the serve engine uses — so a crash-resumed or restarted
+        # run hydrates its 9.9 s of train compile from disk in well under a
+        # second. Opt-in (Training.compile_cache / HYDRAGNN_COMPILE_CACHE);
+        # disabled = the dispatch helper is a pass-through to the jit
+        # wrappers, byte-identical to the historical path. Mesh runs keep
+        # jit (shard_map AOT portability is not certified yet; ROADMAP 5).
+        cache_dir = (
+            compile_cache
+            if compile_cache is not None
+            else os.environ.get("HYDRAGNN_COMPILE_CACHE", "")
+        )
+        self._exec_registry = None
+        self._cache_fingerprint = ""
+        self._cache_flags: tuple = ()
+        if cache_dir and mesh is None:
+            import hashlib
+
+            from ..cache import ExecutableRegistry, ExecutableStore
+            from ..checkpoint.format import param_fingerprint
+
+            self._exec_registry = ExecutableRegistry(
+                ExecutableStore(cache_dir), name="train"
+            )
+            # Program identity: the caller's config digest (run_training
+            # hashes the Architecture + optimizer blocks) on top of the
+            # checkpoint layer's param/opt-state tree fingerprints and the
+            # module field repr — any model/optimizer change is a miss.
+            self._cache_fingerprint = hashlib.sha256(
+                (
+                    compile_cache_fingerprint
+                    + param_fingerprint(state.params)
+                    + param_fingerprint(
+                        {"opt": state.opt_state, "bstats": state.batch_stats}
+                    )
+                    + repr(model)
+                ).encode()
+            ).hexdigest()
+            self._cache_flags = (
+                ("donate",) if donate else ()
+            ) + (("guard",) if guard else ())
         # Whether the 'graph' mesh axis is active (edge arrays then need the
         # P('data','graph') placement the sharded step expects).
         self._graph_sharded = (
@@ -173,6 +219,51 @@ class TrainingDriver:
         # deterministic, dict get/set are single-bytecode atomic under
         # the GIL, and a racing duplicate store just re-memoizes).
         self._sharding_trees: dict = {}  # guarded-by: none(idempotent memo; deterministic value per key; GIL-atomic dict ops; duplicate store is a benign re-memoization)
+
+    # ------------------------------------------------- compiled-step dispatch
+    def _dispatch(self, program: str, fn, shape_key, *args):
+        """Route one compiled-step call through the shared
+        :class:`~hydragnn_tpu.cache.ExecutableRegistry` when the persistent
+        compile cache is enabled; otherwise call the jit wrapper directly
+        (byte-identical to the historical path — the registry is the ONLY
+        behavioral delta, and a cache-hit executable is bit-exact against a
+        fresh compile, tests/test_compile_cache.py).
+
+        ``shape_key`` is the caller's CHEAP signature of the varying
+        arguments (the payload batch's padded shapes — state/rng structure
+        is constant per driver, and the registry is per-driver): steady-state
+        memory hits pay one tuple build, never fingerprint arithmetic. The
+        full args-tree digest and environment key are computed lazily inside
+        the miss closure only."""
+        reg = self._exec_registry
+        if reg is None:
+            return fn(*args)
+        from ..cache import CacheKey, tree_signature
+
+        exe, _outcome, _seconds = reg.lookup_or_compile(
+            (program, shape_key),
+            lambda: CacheKey.for_environment(
+                program=program,
+                config_fingerprint=self._cache_fingerprint,
+                flags=self._cache_flags,
+                args_digest=tree_signature(args),
+            ),
+            lambda: fn.lower(*args),
+        )
+        return exe(*args)
+
+    @staticmethod
+    def _dispatch_shape_key(batch: GraphBatch):
+        """Cheap per-batch signature for _dispatch's in-memory key: padded
+        array shapes plus the head-spec layout (targets change with
+        set_head_spec without moving node shapes — they must miss)."""
+        return (
+            batch.node_features.shape,
+            batch.senders.shape,
+            batch.num_graphs_pad,
+            batch.edge_features is None,
+            tuple(t.shape for t in batch.targets),
+        )
 
     # ----------------------------------------------------------- device feed
     def _sharding_tree(self, batch):
@@ -358,8 +449,10 @@ class TrainingDriver:
                     with prof.annotate("train_step"), telemetry.span(
                         "device_step", index=bi
                     ), timed_consume(self.feed_stats, "step_s"):
-                        self.state, m = self.train_step(
-                            self.state, batch, self.rng
+                        self.state, m = self._dispatch(
+                            "train_step", self.train_step,
+                            self._dispatch_shape_key(batch),
+                            self.state, batch, self.rng,
                         )
                         metrics.update(m)
                     bi += 1
@@ -422,8 +515,10 @@ class TrainingDriver:
                         "device_step", index=int(ci), cached=True
                     ), timed_consume(self.feed_stats, "step_s"):
                         if single:
-                            self.state, m = self.train_step(
-                                self.state, payload, self.rng
+                            self.state, m = self._dispatch(
+                                "train_step", self.train_step,
+                                self._dispatch_shape_key(payload),
+                                self.state, payload, self.rng,
                             )
                         else:
                             # Batch-level order reshuffle WITHIN the chunk too —
@@ -433,8 +528,10 @@ class TrainingDriver:
                             # and batch->chunk assignment stay frozen (the cache).
                             steps = jax.tree_util.tree_leaves(payload)[0].shape[0]
                             perm = jnp.asarray(rng.permutation(steps))
-                            self.state, m = self._perm_scan(
-                                self.state, payload, perm, self.rng
+                            self.state, m = self._dispatch(
+                                "perm_scan", self._perm_scan,
+                                self._dispatch_shape_key(payload),
+                                self.state, payload, perm, self.rng,
                             )
                         metrics.update(m)
                     if self.guard is not None:
@@ -522,9 +619,17 @@ class TrainingDriver:
             "device_step", index=index, chunk=not single
         ), timed_consume(self.feed_stats, "step_s"):
             if single:
-                self.state, m = self.train_step(self.state, payload, self.rng)
+                self.state, m = self._dispatch(
+                    "train_step", self.train_step,
+                    self._dispatch_shape_key(payload),
+                    self.state, payload, self.rng,
+                )
             else:
-                self.state, m = self.epoch_scan(self.state, payload, self.rng)
+                self.state, m = self._dispatch(
+                    "epoch_scan", self.epoch_scan,
+                    self._dispatch_shape_key(payload),
+                    self.state, payload, self.rng,
+                )
             metrics.update(m)
         if self.guard is not None:
             self.guard.after_update(self, m)
@@ -593,7 +698,11 @@ class TrainingDriver:
                 with prof.annotate("eval_step"), telemetry.span(
                     "eval_step", index=ei, cached=True
                 ), timed_consume(self.feed_stats, "step_s"):
-                    m, outputs = self.eval_step(self.state, dev_b)
+                    m, outputs = self._dispatch(
+                        "eval_step", self.eval_step,
+                        self._dispatch_shape_key(dev_b),
+                        self.state, dev_b,
+                    )
                     metrics.update(m)
                 if return_values:
                     consume(host_b, outputs)
@@ -621,7 +730,11 @@ class TrainingDriver:
                     with prof.annotate("eval_step"), telemetry.span(
                         "eval_step", index=ei
                     ), timed_consume(self.feed_stats, "step_s"):
-                        m, outputs = self.eval_step(self.state, dev_b)
+                        m, outputs = self._dispatch(
+                            "eval_step", self.eval_step,
+                            self._dispatch_shape_key(dev_b),
+                            self.state, dev_b,
+                        )
                         metrics.update(m)
                     if return_values:
                         consume(batch, outputs)

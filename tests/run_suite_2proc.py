@@ -98,10 +98,21 @@ def main() -> int:
     elapsed = round(time.time() - t_start, 1)
     ran = []
     per_rank = []
+    # Backend capability gate (mirrors tests/test_multiprocess.py): when a
+    # rank's failures are XLA's own "Multiprocess computations aren't
+    # implemented" (CPU backend has no cross-process collectives), the
+    # 2-process suite is environmentally impossible — report a PRECISE skip
+    # (exit 0, reason in the artifact) instead of a red that names nothing
+    # fixable in the repo. ROADMAP item 5 (portable collective layer) is
+    # the real fix.
+    no_mp_marker = "Multiprocess computations aren't implemented"
+    backend_lacks_mp = False
     for rank, (path, log) in enumerate(logs):
         log.close()
         with open(path) as f:
             text = f.read()
+        if rcs[rank] != 0 and no_mp_marker in text:
+            backend_lacks_mp = True
         m = re.search(r"(\d+) passed", text)
         skipped = re.search(r"(\d+) skipped", text)
         ran.append(int(m.group(1)) if m else 0)
@@ -116,6 +127,14 @@ def main() -> int:
     with open(logs[0][0]) as f:
         sys.stdout.write(f.read())
     print(f"rank return codes: {rcs}; tests passed per rank: {ran}")
+    skip_reason = None
+    if backend_lacks_mp:
+        skip_reason = (
+            "backend lacks multiprocess collectives (XLA: "
+            f"{no_mp_marker!r}) — 2-process suite is environmentally "
+            "impossible on this backend; see ROADMAP item 5"
+        )
+        print(f"SKIPPED: {skip_reason}")
     if artifact:
         import json
 
@@ -129,10 +148,13 @@ def main() -> int:
                     "selection": extra,
                     "ranks": per_rank,
                     "ok": all(rc == 0 for rc in rcs) and all(n > 0 for n in ran),
-                },
+                }
+                | ({"skipped": skip_reason} if skip_reason else {}),
                 f,
                 indent=2,
             )
+    if skip_reason is not None:
+        return 0
     if not all(n > 0 for n in ran):
         # All-skipped still exits 0 from pytest; a selection outside the
         # multi-process-safe set must not read as a green distributed run.

@@ -156,3 +156,44 @@ def pytest_committed_kernels_artifact_readable():
     blk = _last_known_kernels(repo)
     assert blk is not None
     assert set(blk["arms"]) >= {"xla", "pallas_onehot", "pallas_csr", "sorted"}
+
+
+def pytest_last_known_compile_cache_picks_latest_real_round(tmp_path):
+    from bench import _last_known_compile_cache
+
+    real = {
+        "metric": "compile_cache_warm_speedup",
+        "value": 26.7,
+        "unit": "x_cold_vs_warm_warmup_wall",
+        "recompiles_after_warmup": 0,
+        "bit_exact_warm_vs_cold": True,
+        "corrupt_fallback_ok": True,
+        "backend": "cpu",
+    }
+    (tmp_path / "COMPILECACHE_r10.json").write_text(json.dumps(real))
+    # A failed --compile-cache round carries value 0.0 — never "last known".
+    (tmp_path / "COMPILECACHE_r11.json").write_text(
+        json.dumps({"metric": "compile_cache_warm_speedup", "value": 0.0,
+                    "error": "TimeoutError"})
+    )
+    now = time.time()
+    os.utime(tmp_path / "COMPILECACHE_r10.json", (now - 50, now - 50))
+    os.utime(tmp_path / "COMPILECACHE_r11.json", (now - 10, now - 10))
+
+    blk = _last_known_compile_cache(str(tmp_path))
+    assert blk is not None
+    assert blk["value"] == 26.7
+    assert blk["recompiles_after_warmup"] == 0
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "COMPILECACHE_r10.json"
+
+
+def pytest_committed_compile_cache_artifact_readable():
+    """The committed COMPILECACHE_r* round is a valid last-known block (the
+    stale-fallback convention every bench arm follows)."""
+    from bench import _last_known_compile_cache
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_compile_cache(repo)
+    assert blk is not None
+    assert blk["value"] >= 5.0 and blk["bit_exact_warm_vs_cold"] is True

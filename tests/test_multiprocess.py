@@ -20,6 +20,26 @@ from tests.deterministic_graph_data import deterministic_graph_data
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The backend's own "this platform has no multiprocess collectives" error
+# (XLA:CPU raises it at the first cross-process psum). When a worker dies
+# with exactly this, the 2-process test is environmentally impossible — a
+# PRECISE skip, not a failure: nothing in the repo is broken, the backend
+# lacks the capability (ROADMAP item 5 is the portable-collectives fix).
+_NO_MULTIPROCESS_MARKER = "Multiprocess computations aren't implemented"
+
+
+def _skip_if_backend_lacks_multiprocess(outs):
+    for out in outs:
+        if _NO_MULTIPROCESS_MARKER in out:
+            import jax
+
+            pytest.skip(
+                "2-process rendezvous is environmentally dead: the "
+                f"{jax.default_backend()} backend reports "
+                f"{_NO_MULTIPROCESS_MARKER!r} — multi-process DP needs a "
+                "backend with cross-process collectives (ROADMAP item 5)"
+            )
+
 
 def _free_port():
     with socket.socket() as s:
@@ -74,6 +94,8 @@ def _launch_two_process(config, tmp_path, extra_env=None, timeout=420):
                 q.kill()
             pytest.fail("2-process training timed out")
         outs.append(out)
+    if any(p.returncode != 0 for p in procs):
+        _skip_if_backend_lacks_multiprocess(outs)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
     return outs
