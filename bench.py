@@ -67,11 +67,16 @@ def _chip_peak_flops() -> float | None:
 
 
 
-def _scan_harness(batch, hidden, layers, steps, seed=0, compute_dtype=None):
+def _scan_harness(
+    batch, hidden, layers, steps, seed=0, compute_dtype=None, loss_scaling=None
+):
     """Shared setup for the scan-workload arms: build graphs → collate →
     stack → model/optimizer/state → AOT-compile the epoch scan. Returns
     (compiled, state, stacked, key, flops_per_step, compile_s) — ONE
-    protocol so the baseline and large-MFU arms cannot drift apart."""
+    protocol so the baseline, large-MFU, and precision A/B arms cannot drift
+    apart. ``loss_scaling`` (a precision.LossScaleConfig) arms the full
+    Training.precision='bf16' step — dynamic loss scale riding the scan
+    carry — rather than compute-dtype-only bf16."""
     import jax
 
     from __graft_entry__ import DIMS, TYPES, _build_model, _make_graphs
@@ -93,7 +98,11 @@ def _scan_harness(batch, hidden, layers, steps, seed=0, compute_dtype=None):
     variables = init_model_variables(model, b)
     opt = select_optimizer("AdamW", 1e-3)
     state = create_train_state(model, variables, opt)
-    epoch = make_train_epoch_scan(model, opt)
+    if loss_scaling is not None:
+        from hydragnn_tpu.precision import make_loss_scale_state
+
+        state = state.replace(loss_scale=make_loss_scale_state(loss_scaling))
+    epoch = make_train_epoch_scan(model, opt, loss_scaling=loss_scaling)
     key = jax.random.PRNGKey(0)
 
     # AOT compile once: timed as compile_s, reused for cost analysis AND the
@@ -994,6 +1003,312 @@ def compile_cache_main() -> int:
     return 0 if result.get("ok") else 1
 
 
+def _last_known_precision(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent real mixed-precision A/B from any committed PRECISION_*
+    artifact — the graftprec analog of ``_last_known_hardware``. A failed
+    ``--precision`` round embeds this block with ``provenance: "stale"`` so
+    an rc=1 round still carries the last-known-good speedup + gates."""
+
+    def extract(doc):
+        if not doc.get("value") or doc.get("metric") != "precision_ab":
+            return None
+        serve = doc.get("serve") or {}
+        return {
+            "value": doc["value"],
+            "unit": doc.get("unit"),
+            "timings_meaningful": doc.get("timings_meaningful"),
+            "convergence_ok": (doc.get("convergence") or {}).get("ok"),
+            # tri-state on purpose: True/False when arms were measured,
+            # None (unknown) when the artifact carries no serve section —
+            # a failing arm must read as False, never as null/True.
+            "serve_arms_ok": (
+                all(a.get("gate_ok") for a in serve.values())
+                if serve
+                else None
+            ),
+            "backend": doc.get("backend"),
+        }
+
+    return _latest_artifact_block("PRECISION_*.json", extract, search_dir)
+
+
+def precision_main() -> int:
+    """``python bench.py --precision``: the end-to-end mixed-precision A/B
+    (ROADMAP item 3, docs/PRECISION.md). Four sections, one artifact:
+
+    * interleaved f32-vs-bf16 steady-window A/B on the shared scan harness
+      (min-of-windows; arms alternate within each window round so tunnel/RPC
+      drift hits both equally). Includes the FULL bf16 policy arm (loss
+      scaling riding the scan carry) so the scaling overhead is visible next
+      to compute-dtype-only bf16. CPU timings are labeled non-meaningful —
+      XLA:CPU emulates bf16.
+    * step-matched same-seed convergence: identical batch sequence and step
+      count through the f32 step vs the scaled bf16 step; the final-epoch
+      loss rel-diff gate is committed here (acceptance pin).
+    * loss-scale event counts from a seeded ``nan_grad@K`` drill through the
+      faults layer (overflow/backoff/growth counters, zero rollbacks).
+    * serve quantized arms: bf16 + int8 engines over a warmed ladder —
+      tolerance-gate stats and recompiles_after_warmup.
+
+    Writes PRECISION_rNN.json; failure embeds the last known A/B,
+    stale-labeled, per the established convention."""
+    result = {
+        "metric": "precision_ab",
+        "value": 0.0,
+        "unit": "f32_over_bf16_policy_steady_window_time",
+    }
+    from hydragnn_tpu.utils.artifacts import round_tag
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"PRECISION_r{round_tag()}.json",
+    )
+    try:
+        import jax
+
+        from hydragnn_tpu.precision import LossScaleConfig
+
+        backend = jax.default_backend()
+        result["backend"] = backend
+        result["device_kind"] = jax.devices()[0].device_kind
+        result["timings_meaningful"] = backend == "tpu"
+        if backend != "tpu":
+            result["timings_note"] = (
+                "CPU backend: XLA:CPU emulates bf16 (typically SLOWER than "
+                "f32) — the window timings certify workload health only; "
+                "the TPU speedup claim waits on the next hardware batch. "
+                "Convergence and tolerance gates are backend-valid."
+            )
+
+        # ------------------------- interleaved steady-window A/B (3 arms)
+        steps, windows = 20, 4
+        arm_specs = (
+            ("f32", None, None),
+            ("bf16_compute", "bfloat16", None),
+            ("bf16_policy", "bfloat16", LossScaleConfig()),
+        )
+        arms = {}
+        for name, dtype, scaling in arm_specs:
+            compiled, state, stacked, key, _, compile_s = _scan_harness(
+                128, HIDDEN, LAYERS, steps,
+                seed=0, compute_dtype=dtype, loss_scaling=scaling,
+            )
+            state, metrics = compiled(state, stacked, key)  # warmup dispatch
+            jax.block_until_ready(metrics["loss"])
+            arms[name] = {
+                "compiled": compiled, "state": state, "stacked": stacked,
+                "key": key, "times": [], "compile_s": compile_s,
+            }
+        from hydragnn_tpu.analysis import no_recompile
+
+        with no_recompile(action="raise", label="precision A/B windows"):
+            for _ in range(windows):
+                for name in arms:  # interleaved: each round times every arm
+                    a = arms[name]
+                    t0 = time.perf_counter()
+                    a["state"], metrics = a["compiled"](
+                        a["state"], a["stacked"], a["key"]
+                    )
+                    jax.block_until_ready(metrics["loss"])
+                    a["times"].append(time.perf_counter() - t0)
+        for name, a in arms.items():
+            best = min(a["times"])
+            result[name] = {
+                "steady_step_ms": round(1000.0 * best / steps, 4),
+                "steady_step_ms_median": round(
+                    1000.0 * sorted(a["times"])[len(a["times"]) // 2] / steps,
+                    4,
+                ),
+                "compile_s": round(a["compile_s"], 3),
+            }
+        result["value"] = round(
+            min(arms["f32"]["times"]) / min(arms["bf16_policy"]["times"]), 3
+        )
+        result["bf16_compute_speedup"] = round(
+            min(arms["f32"]["times"]) / min(arms["bf16_compute"]["times"]), 3
+        )
+
+        # --------------------- step-matched same-seed convergence (gated)
+        epochs, conv_steps = 8, 10
+        curves = {}
+        for name, dtype, scaling in (
+            ("f32", None, None),
+            ("bf16_policy", "bfloat16", LossScaleConfig()),
+        ):
+            compiled, state, stacked, key, _, _ = _scan_harness(
+                64, 32, LAYERS, conv_steps,
+                seed=2, compute_dtype=dtype, loss_scaling=scaling,
+            )
+            curve = []
+            for _ in range(epochs):
+                state, metrics = compiled(state, stacked, key)
+                curve.append(
+                    round(
+                        float(metrics["loss"]) / float(metrics["count"]), 6
+                    )
+                )
+            curves[name] = curve
+        final_f32, final_bf16 = curves["f32"][-1], curves["bf16_policy"][-1]
+        # The pinned gate (acceptance criterion): bf16-with-master-weights
+        # tracks the same-seed f32 trajectory step for step. Normalized by
+        # the INITIAL loss — the tier-1 convention
+        # (tests/test_mixed_precision.py pytest_bf16_tracks_f32_training):
+        # once the loss has decayed by 10x+, a final-loss denominator turns
+        # bf16 rounding noise into a fake divergence, while a real
+        # divergence is O(initial) and still trips this gate. Measured on
+        # CPU at ~0.016; 0.05 absorbs backend drift.
+        rel = abs(final_bf16 - final_f32) / max(abs(curves["f32"][0]), 1e-9)
+        gate = 0.05
+        result["convergence"] = {
+            "steps_per_epoch": conv_steps,
+            "epochs": epochs,
+            "f32_loss_curve": curves["f32"],
+            "bf16_loss_curve": curves["bf16_policy"],
+            "final_diff_rel_initial": round(rel, 6),
+            "gate_rel_initial": gate,
+            "ok": bool(rel < gate),
+        }
+
+        # ---------------------------- loss-scale events (faults-layer drill)
+        from hydragnn_tpu.faults import FaultCounters, FaultPlan
+        from hydragnn_tpu.graphs import GraphSample
+        from hydragnn_tpu.models import create_model, init_model_variables
+        from hydragnn_tpu.preprocess.dataloader import GraphDataLoader
+        from hydragnn_tpu.telemetry import graftel as telemetry
+        from hydragnn_tpu.train.train_validate_test import TrainingDriver
+        from hydragnn_tpu.train.trainer import create_train_state
+        from hydragnn_tpu.utils.optimizer import select_optimizer
+
+        FaultCounters.reset()
+        telemetry.clear_counters("prec/")
+        rng = np.random.default_rng(0)
+        drill_graphs = []
+        for _ in range(48):
+            n = int(rng.integers(4, 10))
+            x = rng.normal(size=(n, 1)).astype(np.float32)
+            ei = np.stack(
+                [np.arange(n), (np.arange(n) + 1) % n]
+            ).astype(np.int32)
+            drill_graphs.append(
+                GraphSample(
+                    x=x, pos=np.zeros((n, 3), np.float32),
+                    y=np.array([x.sum()], np.float32),
+                    y_loc=np.array([[0, 1]], np.int64), edge_index=ei,
+                )
+            )
+        loader = GraphDataLoader(drill_graphs, batch_size=8, shuffle=False)
+        loader.set_head_spec(("graph",), (1,))
+        heads = {
+            "graph": {
+                "num_sharedlayers": 1, "dim_sharedlayers": 8,
+                "num_headlayers": 2, "dim_headlayers": [8, 8],
+            }
+        }
+        model = create_model(
+            "SAGE", 1, 8, (1,), ("graph",), heads, [1.0], 2
+        )
+        variables = init_model_variables(model, next(iter(loader)))
+        opt = select_optimizer("AdamW", 5e-3)
+        driver = TrainingDriver(
+            model, opt, create_train_state(model, variables, opt),
+            precision="bf16",
+            loss_scale={"init": 2.0**12, "growth_interval": 1000},
+            fault_tolerance={"enabled": 1, "max_bad_steps": 3},
+            fault_plan=FaultPlan("nan_grad@2"),
+        )
+        drill_loss = None
+        for epoch in range(2):
+            loader.set_epoch(epoch)
+            drill_loss, _ = driver.train_epoch(loader)
+        result["loss_scale_events"] = {
+            "drill": "nan_grad@2 under precision=bf16",
+            "overflow": int(telemetry.counter_value("prec/overflow")),
+            "backoff": int(telemetry.counter_value("prec/backoff")),
+            "growth": int(telemetry.counter_value("prec/growth")),
+            "bad_steps": FaultCounters.get("bad_steps"),
+            "rollbacks": driver.guard.rollbacks,
+            "final_scale": float(driver.state.loss_scale.scale),
+            "final_loss_finite": bool(np.isfinite(drill_loss)),
+        }
+
+        # ------------------------------------ serve quantized-arm tolerance
+        import __graft_entry__ as ge
+        from hydragnn_tpu.graphs import collate_graphs
+        from hydragnn_tpu.serve import InferenceEngine
+
+        srng = np.random.default_rng(0)
+        serve_graphs = ge._make_graphs(12, srng)
+        smodel = ge._build_model(hidden=8, layers=2)
+        sbatch = collate_graphs(serve_graphs[:2], ge.TYPES, ge.DIMS, edge_dim=1)
+        svars = init_model_variables(smodel, sbatch)
+        from hydragnn_tpu.serve import PrecisionToleranceError
+
+        result["serve"] = {}
+        for arm, tol in (("bf16", 5e-2), ("int8", 5e-2)):
+            eng = InferenceEngine(
+                smodel, svars, precision=arm, tolerance=tol,
+                max_batch_graphs=8, bucket_ladder=[(256, 1024)], warmup=True,
+            )
+            try:
+                try:
+                    gate_report = eng.check_tolerance()
+                except PrecisionToleranceError as gate_exc:
+                    # A failed gate is a RESULT, not a crashed round: record
+                    # the verdict (gate_ok=False fails the overall ok below)
+                    # and keep measuring the other arm — the artifact must
+                    # stay diagnosable.
+                    gate_report = gate_exc.report
+                arm_block = {
+                    "gate_ok": bool(gate_report["ok"]),
+                    "max_abs_diff": gate_report["fwd_err"],
+                    "tolerance": tol,
+                    "per_head": gate_report["per_head"],
+                    **(
+                        {"quantization": gate_report["quantization"]}
+                        if "quantization" in gate_report
+                        else {}
+                    ),
+                }
+                if gate_report["ok"]:
+                    misses0 = eng.metrics.snapshot()["bucket_cache"]["misses"]
+                    eng.predict(serve_graphs[:8])
+                    snap = eng.metrics.snapshot()
+                    arm_block["recompiles_after_warmup"] = (
+                        snap["bucket_cache"]["misses"] - misses0
+                    )
+                result["serve"][arm] = arm_block
+            finally:
+                eng.close()
+
+        result["ok"] = bool(
+            result["convergence"]["ok"]
+            and result["loss_scale_events"]["rollbacks"] == 0
+            and result["loss_scale_events"]["backoff"] >= 1
+            and all(
+                a["gate_ok"] and a.get("recompiles_after_warmup") == 0
+                for a in result["serve"].values()
+            )
+        )
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        result["artifact"] = os.path.basename(out_path)
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        try:
+            stale = _last_known_precision()
+            if stale is not None:
+                result["last_known_precision"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0 if result.get("ok") else 1
+
+
 def faults_main() -> int:
     """``python bench.py --faults``: run the deterministic fault-drill matrix
     (benchmarks/fault_drills.py) and print it as the round's FAULTS_rNN.json
@@ -1460,6 +1775,8 @@ if __name__ == "__main__":
         sys.exit(trace_main())
     if "--compile-cache" in sys.argv:
         sys.exit(compile_cache_main())
+    if "--precision" in sys.argv:
+        sys.exit(precision_main())
     if "--analyze" in sys.argv:
         sys.exit(analyze_main())
     main()

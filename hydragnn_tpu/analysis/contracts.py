@@ -17,6 +17,9 @@ Every failure is one actionable line tagged with a stable code:
   bad-head-spec     head types/indices/weights/heads blocks disagree
   bad-arch          the Architecture block cannot build a model
   dtype-mismatch    compute_dtype is not a floating dtype
+  bad-precision     Training.precision / loss_scale / serve --precision
+                    nonsense (unknown arm, int8 for training, non-positive
+                    scale knobs, quantized serve without a tolerance bound)
   oob-bucket        a bucket/batch/ladder size cannot hold the data
   donation-misuse   config requests a donating step that would alias buffers
   shape-mismatch    eval_shape found inconsistent shapes/dtypes end to end
@@ -72,6 +75,8 @@ def check_config(
     bucket_ladder: "Optional[Sequence[Tuple[int, int]] | str]" = None,
     strict: bool = True,
     deep: bool = True,
+    serve_precision: Optional[str] = None,
+    serve_tolerance: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Validate a training or serving config statically. Returns the report
     dict; with ``strict`` (the default) raises :class:`ConfigContractError`
@@ -79,7 +84,10 @@ def check_config(
     pass (structural checks only — the entry points use this when
     ``HYDRAGNN_CHECK_CONFIG=structural``). ``bucket_ladder`` accepts parsed
     ``(N_pad, E_pad)`` rungs or any CLI spec string — ``"NxE,..."`` or
-    ``"auto:<path>"`` (resolved via graphs/packing.resolve_ladder_spec)."""
+    ``"auto:<path>"`` (resolved via graphs/packing.resolve_ladder_spec).
+    ``serve_precision``/``serve_tolerance`` are the serve CLI's arm flags
+    (docs/PRECISION.md): quantized arms without a positive tolerance bound
+    are a ``bad-precision`` finding here, before the checkpoint loads."""
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
@@ -96,6 +104,9 @@ def check_config(
     _check_structure(config, arch, voi, training, mode, completed, errors)
     _check_head_spec(config, arch, voi, completed, errors)
     _check_dtype(arch, errors)
+    _check_precision(
+        arch, training, mode, serve_precision, serve_tolerance, errors
+    )
     _check_buckets(config, arch, training, bucket_ladder, mode, errors)
     _check_donation(training, errors)
     _check_aggregation_path(arch, errors)
@@ -106,7 +117,16 @@ def check_config(
     elif not errors:
         key = (
             json.dumps(
-                {"arch": arch, "voi": voi, "ds": _get(config, "Dataset")},
+                {
+                    "arch": arch,
+                    "voi": voi,
+                    "ds": _get(config, "Dataset"),
+                    # Precision changes the TRACED training step (bf16 casts
+                    # + the loss-scale state machine), so it must key the
+                    # shape cache too.
+                    "precision": training.get("precision"),
+                    "loss_scale": training.get("loss_scale"),
+                },
                 sort_keys=True,
                 default=str,
             ),
@@ -141,7 +161,14 @@ def check_config(
     return report
 
 
-def gate_config(config, mode: str = "training", bucket_ladder=None, deep=True):
+def gate_config(
+    config,
+    mode: str = "training",
+    bucket_ladder=None,
+    deep=True,
+    serve_precision=None,
+    serve_tolerance=None,
+):
     """The ONE entry-point gate shared by run_training / run_prediction /
     serve startup: honors ``HYDRAGNN_CHECK_CONFIG`` (``full`` default,
     ``structural`` skips the eval_shape pass, ``off`` disables the gate) and
@@ -157,6 +184,8 @@ def gate_config(config, mode: str = "training", bucket_ladder=None, deep=True):
         mode=mode,
         bucket_ladder=bucket_ladder,
         deep=deep and level != "structural",
+        serve_precision=serve_precision,
+        serve_tolerance=serve_tolerance,
     )
 
 
@@ -354,6 +383,134 @@ def _check_dtype(arch, errors):
                 f"Architecture.compute_dtype {cd!r} is not a floating dtype "
                 "— mixed-precision compute must be float (e.g. 'bfloat16')",
             )
+        )
+
+
+# ------------------------------------------------------------------ precision
+def _check_precision(
+    arch, training, mode, serve_precision, serve_tolerance, errors
+):
+    """graftprec config contract (docs/PRECISION.md): unknown precision
+    strings, int8 for TRAINING, loss-scale knob nonsense, and quantized
+    serving without a tolerance bound are one actionable line here — before
+    the checkpoint loads or the first step compiles."""
+    from ..precision.policy import (
+        QUANTIZED_SERVE_PRECISIONS,
+        SERVE_PRECISIONS,
+        TRAIN_PRECISIONS,
+        LossScaleConfig,
+    )
+
+    if mode == "serving":
+        if serve_precision is None:
+            return
+        if serve_precision not in SERVE_PRECISIONS:
+            errors.append(
+                (
+                    "bad-precision",
+                    f"serving precision {serve_precision!r} is not one of "
+                    f"{SERVE_PRECISIONS}",
+                )
+            )
+        elif serve_precision in QUANTIZED_SERVE_PRECISIONS:
+            if not isinstance(serve_tolerance, (int, float)) or isinstance(
+                serve_tolerance, bool
+            ) or serve_tolerance <= 0:
+                errors.append(
+                    (
+                        "bad-precision",
+                        f"quantized serving (--precision {serve_precision}) "
+                        "requires a positive --tolerance bound — the "
+                        "bit-exactness contract is relaxed, never silently "
+                        f"dropped; got {serve_tolerance!r}",
+                    )
+                )
+        elif serve_tolerance is not None:
+            errors.append(
+                (
+                    "bad-precision",
+                    "--tolerance is a quantized-arm knob; --precision f32 "
+                    "serves under the bit-exactness contract and accepts "
+                    "none",
+                )
+            )
+        return
+    prec = training.get("precision")
+    if prec is not None:
+        if prec == "int8":
+            errors.append(
+                (
+                    "bad-precision",
+                    "Training.precision='int8' is not a training mode — "
+                    "int8 is a quantized SERVING arm (--precision int8); "
+                    "train with 'bf16' and quantize at serve time",
+                )
+            )
+        elif prec not in TRAIN_PRECISIONS:
+            errors.append(
+                (
+                    "bad-precision",
+                    f"Training.precision {prec!r} is not one of "
+                    f"{TRAIN_PRECISIONS}",
+                )
+            )
+        elif prec == "f32" and arch.get("compute_dtype") == "bfloat16":
+            errors.append(
+                (
+                    "bad-precision",
+                    "Training.precision='f32' contradicts "
+                    "Architecture.compute_dtype='bfloat16' — drop one (the "
+                    "policy would silently not be full f32)",
+                )
+            )
+        elif prec == "bf16" and arch.get("compute_dtype") not in (
+            None,
+            "bfloat16",
+        ):
+            # The other direction of the same contradiction: the driver only
+            # clones onto bf16 compute when compute_dtype is unset, so an
+            # explicit non-bf16 dtype would silently train at THAT dtype
+            # with pointless loss scaling armed.
+            errors.append(
+                (
+                    "bad-precision",
+                    "Training.precision='bf16' contradicts "
+                    f"Architecture.compute_dtype="
+                    f"{arch.get('compute_dtype')!r} — bf16 training needs "
+                    "compute_dtype unset (the policy sets it) or 'bfloat16'",
+                )
+            )
+        if (
+            prec == "bf16"
+            and str(training.get("optimizer", "")).upper() == "LBFGS"
+        ):
+            errors.append(
+                (
+                    "bad-precision",
+                    "Training.precision='bf16' (dynamic loss scaling) does "
+                    "not support LBFGS — the zoom linesearch is not "
+                    "scale-invariant under dynamic rescaling; use a "
+                    "first-order optimizer",
+                )
+            )
+    ls = training.get("loss_scale")
+    if ls is None:
+        return
+    if not isinstance(ls, dict):
+        errors.append(
+            (
+                "bad-precision",
+                f"Training.loss_scale must be a dict of scale knobs "
+                f"(init/backoff/growth/growth_interval), got "
+                f"{type(ls).__name__}",
+            )
+        )
+        return
+    try:
+        LossScaleConfig.from_config(ls)
+    except (TypeError, ValueError) as e:
+        errors.append(
+            ("bad-precision", f"Training.loss_scale is invalid: {e}")
         )
 
 
@@ -616,18 +773,46 @@ def _check_shapes(config, arch, voi, training, mode, completed, errors, skipped)
             train=False,
         )
 
-    def _trace_training(batch, key):
+    # Precision policy (docs/PRECISION.md): with Training.precision="bf16"
+    # the gate traces the MIXED-PRECISION step — bf16 compute casts plus the
+    # in-jit loss-scale machine — so a dtype bug in a head/loss/optimizer
+    # path fails here, not at step 1. The loss-scale state enters as
+    # ShapeDtypeStructs (this check must still never allocate device arrays).
+    bf16_policy = None
+    if mode == "training" and training.get("precision") == "bf16":
+        from ..precision.policy import LossScaleConfig
+
+        try:
+            bf16_policy = LossScaleConfig.from_config(
+                training.get("loss_scale")
+            )
+        except (TypeError, ValueError):
+            bf16_policy = None  # already a bad-precision structural finding
+
+    def _trace_training(batch, key, ls=None):
         from ..train.trainer import _step_body, create_train_state
         from ..utils.optimizer import select_optimizer
 
-        variables = model.init(
+        step_model = (
+            model.clone(compute_dtype="bfloat16")
+            if ls is not None and model.compute_dtype is None
+            else model
+        )
+        variables = step_model.init(
             {"params": key, "dropout": key}, batch, train=False
         )
         # AdamW regardless of Training.optimizer: the shape contract is
         # optimizer-independent (module docstring).
-        state = create_train_state(model, variables, select_optimizer("AdamW", 1e-3))
+        state = create_train_state(
+            step_model, variables, select_optimizer("AdamW", 1e-3)
+        )
+        if ls is not None:
+            state = state.replace(loss_scale=ls)
         new_state, metrics = _step_body(
-            model, select_optimizer("AdamW", 1e-3), guard=True
+            step_model,
+            select_optimizer("AdamW", 1e-3),
+            guard=True,
+            loss_scaling=bf16_policy,
         )(state, batch, key)
         return metrics
 
@@ -638,7 +823,18 @@ def _check_shapes(config, arch, voi, training, mode, completed, errors, skipped)
                 out_shapes, output_dim, output_type, example, errors
             )
         else:
-            metrics = jax.eval_shape(_trace_training, batch_sds, key_sds)
+            if bf16_policy is not None:
+                from ..precision.policy import LossScaleState
+
+                ls_sds = LossScaleState(
+                    scale=jax.ShapeDtypeStruct((), np.float32),
+                    good_steps=jax.ShapeDtypeStruct((), np.int32),
+                )
+                metrics = jax.eval_shape(
+                    _trace_training, batch_sds, key_sds, ls_sds
+                )
+            else:
+                metrics = jax.eval_shape(_trace_training, batch_sds, key_sds)
             loss = metrics["loss"]
             if loss.shape != () or not np.issubdtype(loss.dtype, np.floating):
                 errors.append(

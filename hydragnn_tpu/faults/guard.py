@@ -140,8 +140,19 @@ class StepGuard:
     def rollback(self, driver) -> None:
         """Restore the retained last-good state (a fresh copy — the snapshot
         itself survives for the next rollback) and back off the LR."""
+        # Mixed-precision interplay (docs/PRECISION.md): the loss-scale state
+        # must SURVIVE the rollback. The snapshot predates the overflow, so
+        # restoring its scale would re-raise the scale that just overflowed
+        # and the next attempt would trip the guard again — a rollback storm.
+        # The backed-off live scale is precisely the adaptation the policy
+        # made; params/opt/batch_stats roll back, the scale does not.
+        live_loss_scale = getattr(driver.state, "loss_scale", None)
         if self._snap is not None:
             driver.state = _copy_state(self._snap)
+            if live_loss_scale is not None:
+                driver.state = driver.state.replace(
+                    loss_scale=live_loss_scale
+                )
         if self.lr_backoff and self.lr_backoff != 1.0:
             lr = get_learning_rate(driver.state.opt_state)
             if lr is not None:

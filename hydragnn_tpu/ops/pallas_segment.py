@@ -1007,6 +1007,10 @@ def certify_pallas(
         # (masked rows must target padding segments), so their accuracy is
         # checked against their own f64 truth. Forward AND gradient, like
         # the other arms.
+        # Shared tolerance gate (precision/tolerance.py): the same fwd/grad
+        # bounds every consumer of "within tolerance" uses.
+        from ..precision.tolerance import KERNEL_CERT_GATE as _gate
+
         sorted_res = None
         if contiguous and (sorted_arm or csr_arm):
             d64 = np.asarray(data, np.float64)
@@ -1081,8 +1085,8 @@ def certify_pallas(
                     sorted_ms=round(sorted_ms, 4),
                     sorted_err_fwd=err,
                     sorted_err_grad=err_grad,
-                    sorted_ok=err < 5e-4
-                    and err_grad <= max(5e-4, xla_err_grad),
+                    sorted_ok=err < _gate.fwd
+                    and err_grad <= max(_gate.fwd, xla_err_grad),
                     sorted_speedup_vs_xla=round(
                         sorted_ms and xla_ms / sorted_ms, 3
                     ),
@@ -1100,15 +1104,14 @@ def certify_pallas(
                     },
                     row_ptr,
                 )
-                # Same gates as the one-hot kernel (the tol/tol_grad pins
-                # below): the CSR kernel shares its bf16x2 split and
-                # analytic backward, so kernel-grade 5e-4 fwd / 5e-3 grad
-                # apply unchanged.
+                # Same gates as the one-hot kernel (KERNEL_CERT_GATE): the
+                # CSR kernel shares its bf16x2 split and analytic backward,
+                # so kernel-grade 5e-4 fwd / 5e-3 grad apply unchanged.
                 sorted_res.update(
                     csr_ms=round(csr_ms, 4),
                     csr_err_fwd=err,
                     csr_err_grad=err_grad,
-                    csr_ok=err < 5e-4 and err_grad < 5e-3,
+                    csr_ok=_gate.check(err, err_grad)["ok"],
                     csr_speedup_vs_xla=round(csr_ms and xla_ms / csr_ms, 3),
                 )
     finally:
@@ -1116,9 +1119,11 @@ def certify_pallas(
             os.environ.pop("HYDRAGNN_PALLAS", None)
         else:
             os.environ["HYDRAGNN_PALLAS"] = _saved_env
-    # Single source of truth for the certification tolerances (bench.py and
-    # tests/test_pallas_tpu.py both consume the verdict, not their own pins).
-    # Forward: strict 5e-4. Gradient: 5e-3 — the ANALYTIC worst case of an
+    # Single source of truth for the certification tolerances is now the
+    # SHARED gate in precision/tolerance.py (KERNEL_CERT_GATE) — one
+    # implementation for kernel certification and the quantized serving arm,
+    # so the two can never drift on what "within tolerance" means. Forward:
+    # strict 5e-4. Gradient: 5e-3 — the ANALYTIC worst case of an
     # accurate-mean kernel, not slack. The sigma cotangent at a count-n
     # segment contributes d_std/(std*n)*(x-mu); at near-degenerate pairs
     # (std -> sqrt(eps) = 3.16e-3, the floor the forward pins) the factor
@@ -1128,17 +1133,19 @@ def certify_pallas(
     # std~3.5e-3 segments; the XLA incumbent carries 0.11 at the same
     # elements). Anything above 5e-3 therefore indicates a real defect,
     # while a uniform 5e-4 would reject every f32-mean-based formula.
-    tol = 5e-4
-    tol_grad = 5e-3
+    from ..precision.tolerance import KERNEL_CERT_GATE
+
+    verdict = KERNEL_CERT_GATE.check(
+        max(max_err_fwd, wide_err_fwd), max(max_err_grad, wide_err_grad)
+    )
     return {
         "backend": _platform(),
         "pallas_enabled": pallas_enabled(),
         "pallas_skip": pallas_skip_enabled(),
         "contiguous_ids": contiguous,
-        "ok": max(max_err_fwd, wide_err_fwd) < tol
-        and max(max_err_grad, wide_err_grad) < tol_grad,
-        "tol": tol,
-        "tol_grad": tol_grad,
+        "ok": verdict["ok"],
+        "tol": KERNEL_CERT_GATE.fwd,
+        "tol_grad": KERNEL_CERT_GATE.grad,
         "max_err_fwd": max_err_fwd,
         "max_err_grad": max_err_grad,
         "err_components": err_components,

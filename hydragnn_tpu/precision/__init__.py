@@ -1,0 +1,56 @@
+"""graftprec — the end-to-end precision policy layer (docs/PRECISION.md).
+
+One policy surface threaded through trainer, guard, serve, and cache:
+
+* training: ``Training.precision = "f32" | "bf16"`` — bf16 compute with f32
+  master weights plus dynamic loss scaling (:mod:`.policy`); ``"f32"``
+  compiles the byte-identical seed step.
+* serving: ``--precision f32 | bf16 | int8`` — a tolerance-gated quantized
+  arm (:mod:`.quantize` for the int8 weight grid, :mod:`.tolerance` for the
+  gate the bit-exactness contract relaxes to in quantized mode only).
+* kernels: certification tolerances (:data:`KERNEL_CERT_GATE`) are the SAME
+  gate implementation the quantized serve arm uses — one definition of
+  "within tolerance" for the whole stack.
+"""
+
+from .policy import (
+    QUANTIZED_SERVE_PRECISIONS,
+    SERVE_PRECISIONS,
+    TRAIN_PRECISIONS,
+    LossScaleConfig,
+    LossScaleMonitor,
+    LossScaleState,
+    PrecisionPolicy,
+    loss_scale_update,
+    make_loss_scale_state,
+)
+from .quantize import (
+    dequantize_tensor,
+    fake_quantize_params,
+    quantize_tensor_symmetric,
+)
+from .tolerance import (
+    KERNEL_CERT_GATE,
+    ToleranceGate,
+    max_abs_diff,
+    tolerance_report,
+)
+
+__all__ = [
+    "KERNEL_CERT_GATE",
+    "LossScaleConfig",
+    "LossScaleMonitor",
+    "LossScaleState",
+    "PrecisionPolicy",
+    "QUANTIZED_SERVE_PRECISIONS",
+    "SERVE_PRECISIONS",
+    "TRAIN_PRECISIONS",
+    "ToleranceGate",
+    "dequantize_tensor",
+    "fake_quantize_params",
+    "loss_scale_update",
+    "make_loss_scale_state",
+    "max_abs_diff",
+    "quantize_tensor_symmetric",
+    "tolerance_report",
+]

@@ -1,0 +1,239 @@
+"""The precision policy layer (docs/PRECISION.md).
+
+``Training.precision`` selects the TRAINING arithmetic end to end:
+
+* ``"f32"`` (or absent) — the seed behavior. The compiled step is
+  byte-identical to a build without this module loaded (locked by
+  tests/test_precision.py): no loss-scale state, no extra casts, nothing.
+* ``"bf16"`` — bf16 compute with f32 master weights plus DYNAMIC loss
+  scaling. The model's existing ``compute_dtype`` mechanism does the casting
+  (params + features cast INSIDE the differentiated function, so gradients
+  accumulate against the f32 masters — trainer._apply_model); this module
+  adds the loss-scale state machine that makes bf16's narrow exponent range
+  survivable: the loss is multiplied by a running scale before
+  ``value_and_grad``, gradients are unscaled before the optimizer, and an
+  overflow (non-finite unscaled grads) SKIPS the update and backs the scale
+  off — the in-jit half of the StepGuard non-finite policy
+  (docs/FAULT_TOLERANCE.md), which the guard's host half then counts,
+  flight-records, and (on a persistent streak) rolls back around.
+
+The scale update lives INSIDE the compiled step (it must ride ``lax.scan``
+epochs per-step, not per-chunk), as pure ``jnp.where`` selects — the same
+no-``lax.cond`` rule the guard follows so fusion boundaries never move.
+
+Serving arms (``--precision f32|bf16|int8``) are validated here; the int8
+weight grid lives in :mod:`.quantize`, the relaxed gate in
+:mod:`.tolerance`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from flax import struct
+
+TRAIN_PRECISIONS = ("f32", "bf16")
+SERVE_PRECISIONS = ("f32", "bf16", "int8")
+QUANTIZED_SERVE_PRECISIONS = ("bf16", "int8")
+
+
+@dataclass(frozen=True)
+class LossScaleConfig:
+    """Dynamic loss-scale knobs (the ``Training.loss_scale`` block).
+
+    Defaults follow the standard dynamic-scaling recipe: start high, halve on
+    every overflow, double after ``growth_interval`` consecutive clean steps,
+    clamp to [min_scale, max_scale]."""
+
+    init: float = 2.0**15
+    backoff: float = 0.5
+    growth: float = 2.0
+    growth_interval: int = 200
+    min_scale: float = 1.0
+    max_scale: float = 2.0**24
+
+    @classmethod
+    def from_config(cls, cfg: Optional[Dict[str, Any]]) -> "LossScaleConfig":
+        cfg = dict(cfg or {})
+        known = {
+            "init", "backoff", "growth", "growth_interval",
+            "min_scale", "max_scale",
+        }
+        unknown = sorted(set(cfg) - known)
+        if unknown:
+            # A typo'd knob must never silently train with defaults — this
+            # feeds the same bad-precision line the value checks do.
+            raise ValueError(
+                f"loss_scale has unknown key(s) {unknown}; valid knobs: "
+                f"{sorted(known)}"
+            )
+        out = cls(
+            init=float(cfg.get("init", cls.init)),
+            backoff=float(cfg.get("backoff", cls.backoff)),
+            growth=float(cfg.get("growth", cls.growth)),
+            growth_interval=int(cfg.get("growth_interval", cls.growth_interval)),
+            min_scale=float(cfg.get("min_scale", cls.min_scale)),
+            max_scale=float(cfg.get("max_scale", cls.max_scale)),
+        )
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        """The loss-scale sanity contract (mirrored by contracts.check_config
+        as a static ``bad-precision`` finding)."""
+        if self.init <= 0:
+            raise ValueError(f"loss_scale.init {self.init} must be > 0")
+        if not (0.0 < self.backoff < 1.0):
+            raise ValueError(
+                f"loss_scale.backoff {self.backoff} must be in (0, 1) — it "
+                "SHRINKS the scale on overflow"
+            )
+        if self.growth <= 1.0:
+            raise ValueError(
+                f"loss_scale.growth {self.growth} must be > 1 — it GROWS the "
+                "scale after clean steps"
+            )
+        if self.growth_interval < 1:
+            raise ValueError(
+                f"loss_scale.growth_interval {self.growth_interval} must be >= 1"
+            )
+        if not (0.0 < self.min_scale <= self.init <= self.max_scale):
+            raise ValueError(
+                "loss_scale bounds must satisfy 0 < min_scale <= init <= "
+                f"max_scale (got min={self.min_scale} init={self.init} "
+                f"max={self.max_scale})"
+            )
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Resolved training precision: compute dtype + loss-scale config."""
+
+    mode: str  # "bf16" (f32 resolves to None — no policy object at all)
+    compute_dtype: str
+    loss_scale: LossScaleConfig
+
+    @staticmethod
+    def resolve(
+        precision: Optional[str], loss_scale_cfg: Optional[Dict[str, Any]] = None
+    ) -> Optional["PrecisionPolicy"]:
+        """``Training.precision`` + ``Training.loss_scale`` → policy, or None
+        for the seed f32 path. Unknown strings and int8-for-training raise
+        (the runtime mirror of the check_config gate)."""
+        if precision in (None, "", "f32"):
+            return None
+        if precision == "int8":
+            raise ValueError(
+                "Training.precision='int8' is not a training mode — int8 is "
+                "a quantized SERVING arm (--precision int8); train with "
+                "'bf16' and quantize the checkpoint at serve time"
+            )
+        if precision != "bf16":
+            raise ValueError(
+                f"Training.precision {precision!r} is not one of "
+                f"{TRAIN_PRECISIONS}"
+            )
+        return PrecisionPolicy(
+            mode="bf16",
+            compute_dtype="bfloat16",
+            loss_scale=LossScaleConfig.from_config(loss_scale_cfg),
+        )
+
+
+# --------------------------------------------------------- in-jit scale state
+@struct.dataclass
+class LossScaleState:
+    """Device-side dynamic-scale state. Rides in ``TrainState.loss_scale`` so
+    it threads through scan carries, guard snapshots, and donation unchanged.
+    Not persisted by checkpoints — a resumed run re-warms its scale, which
+    dynamic scaling recovers in ~growth_interval steps."""
+
+    scale: Any
+    good_steps: Any
+
+
+def make_loss_scale_state(cfg: LossScaleConfig) -> LossScaleState:
+    import jax.numpy as jnp
+
+    return LossScaleState(
+        scale=jnp.asarray(cfg.init, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+    )
+
+
+def loss_scale_update(ls, ok, cfg: LossScaleConfig):
+    """One step of the dynamic-scale state machine, inside the jit.
+
+    ``ok`` is the step's all-finite flag over the UNSCALED loss/grads.
+    Returns ``(new_state, grew)``: overflow → scale * backoff (floored),
+    streak of ``growth_interval`` clean steps → scale * growth (capped);
+    pure ``where`` selects, per the guard's fusion-boundary rule."""
+    import jax.numpy as jnp
+
+    good = jnp.where(ok, ls.good_steps + 1, 0)
+    grew = jnp.logical_and(ok, good >= cfg.growth_interval)
+    scale = jnp.where(
+        ok,
+        jnp.where(
+            grew,
+            jnp.minimum(ls.scale * cfg.growth, cfg.max_scale),
+            ls.scale,
+        ),
+        jnp.maximum(ls.scale * cfg.backoff, cfg.min_scale),
+    )
+    new = ls.replace(
+        scale=scale, good_steps=jnp.where(grew, 0, good).astype(jnp.int32)
+    )
+    return new, grew
+
+
+# ------------------------------------------------------------- host half
+class LossScaleMonitor:
+    """Host-side observability of the in-jit scale machine — the precision
+    analog of StepGuard's counting half, called next to it by the driver on
+    every step/chunk update (docs/PRECISION.md "Telemetry").
+
+    Emits: ``train/loss_scale`` gauge, ``prec/overflow`` / ``prec/backoff`` /
+    ``prec/growth`` counters (plus FaultCounters ``loss_scale_backoff`` so
+    the end-of-run fault report carries it), and a flight-recorder event per
+    backoff batch — the ring then shows WHEN the scale moved next to the
+    collate/h2d/device spans of the step that overflowed."""
+
+    def __init__(self, verbosity: int = 0):
+        self.verbosity = verbosity
+        self.overflows = 0
+        self.growths = 0
+
+    def after_update(self, driver, metrics) -> None:
+        from ..faults.counters import FaultCounters
+        from ..telemetry import graftel as telemetry
+        from ..utils.print_utils import print_distributed
+
+        ls = getattr(driver.state, "loss_scale", None)
+        if ls is None:
+            return
+        scale = float(ls.scale)
+        telemetry.gauge("train/loss_scale", scale)
+        overflows = int(round(float(metrics.get("overflow", 0.0))))
+        growths = int(round(float(metrics.get("scale_growths", 0.0))))
+        if overflows:
+            self.overflows += overflows
+            telemetry.counter("prec/overflow", overflows)
+            # One backoff fires per overflowing step, so the counts alias —
+            # kept as two names because dashboards read them as cause/effect.
+            telemetry.counter("prec/backoff", overflows)
+            FaultCounters.inc("loss_scale_backoff", overflows)
+            telemetry.event(
+                "prec/loss_scale_backoff",
+                overflows=overflows,
+                scale=scale,
+            )
+            print_distributed(
+                self.verbosity,
+                f"precision: {overflows} overflow step(s), "
+                f"loss scale now {scale:g}",
+            )
+        if growths:
+            self.growths += growths
+            telemetry.counter("prec/growth", growths)

@@ -1,0 +1,77 @@
+"""Post-training weight quantization for the serve quantized arm
+(docs/PRECISION.md "Serving arms").
+
+``--precision int8`` is per-tensor symmetric weight quantization with bf16
+activations: every weight matrix is snapped to a 255-level int8 grid
+(``scale = max|w| / 127``, ``q = round(w / scale)``), the forward runs the
+model's bf16 compute path over the DEQUANTIZED weights. This is the standard
+"fake-quant" (simulated-quantization) serving arm: numerics are exactly those
+of int8 weight storage — every served output is bit-identical to what a true
+int8-weight executable would produce after its dequantize — while the
+executable itself stays a plain XLA program the whole bucket-ladder /
+graftcache machinery already handles. True int8 HBM residency is a hardware
+follow-up (ROADMAP item 3); the TOLERANCE contract and cache-key separation
+land here and carry over unchanged.
+
+Policy: leaves with ``ndim >= 2`` (the matmul weights — where the bytes and
+the MXU work are) quantize; biases, BatchNorm statistics, and other vectors/
+scalars stay exact, matching standard post-training-quantization practice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+INT8_LEVELS = 127  # symmetric: [-127, 127], -128 unused
+
+
+def quantize_tensor_symmetric(w: np.ndarray) -> Tuple[np.ndarray, float]:
+    """Per-tensor symmetric int8 quantization → (int8 values, f32 scale).
+    An all-zero tensor quantizes to zeros with scale 0.0 (dequantizes
+    exactly)."""
+    w = np.asarray(w, np.float32)
+    amax = float(np.max(np.abs(w))) if w.size else 0.0
+    if amax == 0.0:
+        return np.zeros(w.shape, np.int8), 0.0
+    scale = amax / INT8_LEVELS
+    q = np.clip(np.rint(w / scale), -INT8_LEVELS, INT8_LEVELS)
+    return q.astype(np.int8), scale
+
+
+def dequantize_tensor(q: np.ndarray, scale: float) -> np.ndarray:
+    return (np.asarray(q, np.float32) * np.float32(scale)).astype(np.float32)
+
+
+def fake_quantize_params(params: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Round-trip every weight matrix of a param pytree through the int8 grid
+    (quantize → dequantize, values land exactly on representable points).
+    Returns ``(quantized params, report)`` where the report carries tensor
+    counts and the worst per-tensor quantization step (the grid resolution —
+    an upper bound on any single weight's rounding error)."""
+    import jax
+
+    quantized = 0
+    kept = 0
+    max_step = 0.0
+
+    def leaf(w):
+        nonlocal quantized, kept, max_step
+        arr = np.asarray(w)
+        if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
+            q, scale = quantize_tensor_symmetric(arr)
+            quantized += 1
+            max_step = max(max_step, scale)
+            return dequantize_tensor(q, scale)
+        kept += 1
+        return arr
+
+    out = jax.tree_util.tree_map(leaf, params)
+    report = {
+        "scheme": "per-tensor symmetric int8 weights, bf16 activations",
+        "tensors_quantized": quantized,
+        "tensors_kept_exact": kept,
+        "max_quant_step": max_step,
+    }
+    return out, report

@@ -251,6 +251,20 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
             {
                 "architecture": config["NeuralNetwork"]["Architecture"],
                 "optimizer": training_cfg.get("optimizer"),
+                # Precision changes the compiled program (bf16 casts + the
+                # loss-scale state machine) without changing any tree shape —
+                # a key component (docs/PRECISION.md), belt to the driver's
+                # flags suspenders. Folded in ONLY when a policy is active:
+                # f32 runs must keep their pre-graftprec digests so existing
+                # stores stay warm across the upgrade.
+                **(
+                    {
+                        "precision": training_cfg["precision"],
+                        "loss_scale": training_cfg.get("loss_scale"),
+                    }
+                    if training_cfg.get("precision") not in (None, "f32")
+                    else {}
+                ),
             },
             sort_keys=True,
             default=str,
@@ -281,6 +295,11 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
         fault_plan=fault_plan,
         compile_cache=compile_cache_dir,
         compile_cache_fingerprint=compile_cache_fp,
+        # graftprec (docs/PRECISION.md): Training.precision = "f32"|"bf16";
+        # bf16 trains in bf16 compute against f32 master weights with dynamic
+        # loss scaling (Training.loss_scale block tunes it).
+        precision=training_cfg.get("precision"),
+        loss_scale=training_cfg.get("loss_scale"),
     )
 
     # Visualizer gets the test set's input node features and graph sizes

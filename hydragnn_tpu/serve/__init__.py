@@ -12,6 +12,7 @@ from .engine import (
     EngineFailedError,
     InferenceEngine,
     NonFiniteOutputError,
+    PrecisionToleranceError,
 )
 from .metrics import LatencyHistogram, ServeMetrics
 from .server import InferenceServer, parse_graph
@@ -24,6 +25,7 @@ __all__ = [
     "InferenceServer",
     "LatencyHistogram",
     "NonFiniteOutputError",
+    "PrecisionToleranceError",
     "ServeMetrics",
     "parse_graph",
 ]

@@ -77,6 +77,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="round-up ladder for shapes that miss the bucket ladder: "
         "mult64 pads a 520-node batch to 576 instead of 1024",
     )
+    ap.add_argument(
+        "--precision",
+        choices=("f32", "bf16", "int8"),
+        default="f32",
+        help="serving arm (docs/PRECISION.md): f32 keeps the bit-exactness "
+        "contract; bf16 runs the forward in bf16 compute; int8 additionally "
+        "quantizes weight matrices to a per-tensor symmetric int8 grid. "
+        "Quantized arms require --tolerance and pass a startup gate against "
+        "an f32 reference before taking traffic",
+    )
+    ap.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        metavar="MAX_ABS_DIFF",
+        help="max absolute output divergence from the f32 reference the "
+        "quantized arm may show (required with --precision bf16|int8; "
+        "invalid with f32)",
+    )
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument(
         "--compile-cache",
@@ -133,6 +152,8 @@ def main(argv=None) -> int:
         bucket_ladder=ladder
         if ladder is not None
         else (args.bucket_ladder or None),
+        serve_precision=args.precision,
+        serve_tolerance=args.tolerance,
     )
     if parse_error is not None:
         # The gate normally turns a bad spec into one actionable oob-bucket
@@ -171,7 +192,21 @@ def main(argv=None) -> int:
         max_worker_restarts=args.max_worker_restarts,
         guard_outputs=not args.no_output_guard,
         compile_cache=args.compile_cache,
+        precision=args.precision,
+        tolerance=args.tolerance,
     )
+    if args.precision != "f32":
+        # The quantized arm's startup gate (docs/PRECISION.md): compare the
+        # serving executable against the retained f32 reference on a seeded
+        # probe batch BEFORE taking traffic — a PrecisionToleranceError here
+        # aborts startup with the full per-head verdict.
+        report = engine.check_tolerance()
+        print(
+            f"precision gate: arm={args.precision} "
+            f"max_abs_diff={report['fwd_err']:.3e} "
+            f"tolerance={args.tolerance:g} ok={report['ok']}",
+            flush=True,
+        )
     server = InferenceServer(
         engine, host=args.host, port=args.port, verbose=args.verbose
     )

@@ -86,6 +86,17 @@ class NonFiniteOutputError(RuntimeError):
     docs/FAULT_TOLERANCE.md)."""
 
 
+class PrecisionToleranceError(RuntimeError):
+    """The quantized arm's outputs diverged from the f32 reference beyond the
+    declared tolerance bound (docs/PRECISION.md "Tolerance gate"). Raised by
+    :meth:`InferenceEngine.check_tolerance`; the full verdict rides on
+    ``report``."""
+
+    def __init__(self, message: str, report: dict):
+        super().__init__(message)
+        self.report = report
+
+
 class _Future:
     """Minimal thread-safe future.
 
@@ -200,6 +211,17 @@ class InferenceEngine:
         (pending/queued requests fail, the engine goes ``degraded`` but keeps
         accepting traffic) instead of poisoning the engine. 0 = the
         historical binary poisoning.
+    precision, tolerance:
+        Serving arm (docs/PRECISION.md): ``"f32"`` (default) keeps the
+        bit-exactness contract against ``run_prediction``; ``"bf16"`` runs
+        the forward in bf16 compute (f32 weights, cast in-executable);
+        ``"int8"`` additionally snaps every weight matrix to a per-tensor
+        symmetric int8 grid (precision/quantize.py). Both quantized arms
+        REQUIRE a positive ``tolerance`` — the bit-exactness gate relaxes to
+        :meth:`check_tolerance` (max-abs-diff vs a retained f32 reference,
+        shared machinery with certify_pallas) for quantized mode only. The
+        arm is a CacheKey policy component: quantized executables can never
+        hydrate an f32 entry or vice versa.
     compile_cache:
         Optional graftcache directory (docs/COMPILE_CACHE.md). With it set,
         ``warmup()`` and cache misses first try to HYDRATE the executable
@@ -232,17 +254,64 @@ class InferenceEngine:
         guard_outputs: bool = True,
         max_worker_restarts: int = 0,
         compile_cache: Optional[str] = None,
+        precision: str = "f32",
+        tolerance: Optional[float] = None,
         autostart: bool = True,
     ):
         import jax
 
+        from ..precision import SERVE_PRECISIONS, fake_quantize_params
         from ..train.trainer import _apply_model
+
+        # Precision arm resolution (docs/PRECISION.md) BEFORE anything reads
+        # the model: quantized arms serve a bf16-compute clone (and, for
+        # int8, grid-snapped weights) while the original f32 model+variables
+        # are retained as the tolerance gate's reference.
+        if precision not in SERVE_PRECISIONS:
+            raise ValueError(
+                f"precision {precision!r} is not one of {SERVE_PRECISIONS}"
+            )
+        self.precision = precision
+        self.tolerance = None if tolerance is None else float(tolerance)
+        self._quant_report: Optional[Dict[str, Any]] = None
+        self._ref_model = None
+        self._ref_variables: Optional[Dict[str, Any]] = None
+        if precision != "f32":
+            if self.tolerance is None or self.tolerance <= 0:
+                raise ValueError(
+                    f"quantized serving (precision={precision!r}) requires a "
+                    "positive tolerance bound — the bit-exactness contract "
+                    "is relaxed, never silently dropped (docs/PRECISION.md)"
+                )
+            # The gate's reference must be a REAL f32 forward: a checkpoint
+            # whose Architecture already pins compute_dtype='bfloat16' would
+            # otherwise be its own reference (max_abs_diff identically 0 —
+            # a vacuous gate claiming a bound that was never measured).
+            self._ref_model = (
+                model
+                if model.compute_dtype is None
+                else model.clone(compute_dtype=None)
+            )
+            self._ref_variables = variables
+            if model.compute_dtype != "bfloat16":
+                model = model.clone(compute_dtype="bfloat16")
+            if precision == "int8":
+                variables = dict(variables)
+                variables["params"], self._quant_report = fake_quantize_params(
+                    variables["params"]
+                )
+        elif tolerance is not None:
+            raise ValueError(
+                "tolerance is a quantized-arm knob; precision='f32' serves "
+                "under the bit-exactness contract and accepts none"
+            )
 
         self.model = model
         self.max_batch_graphs = int(max_batch_graphs)
         self.max_delay_ms = float(max_delay_ms)
         self.queue_limit = int(queue_limit)
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.metrics.set_precision(self.precision, self.tolerance)
         self.head_names = (
             list(head_names)
             if head_names
@@ -287,6 +356,15 @@ class InferenceEngine:
         # field repr (hyperparameters without parameters — activation,
         # aggregation list — change the program but not the param tree).
         self._config_fingerprint = ""
+        # Precision is BOTH a fingerprint component and a named CacheKey flag
+        # (docs/PRECISION.md "Cache-key interaction"): the model repr already
+        # separates f32 from the bf16-compute clone, but bf16 and int8 share
+        # a module repr and a param-tree signature (int8 quantization moves
+        # VALUES, not shapes/dtypes) — the explicit arm label is what makes
+        # cross-precision hydration structurally impossible.
+        self._key_flags: Tuple[str, ...] = (
+            () if self.precision == "f32" else (f"precision={self.precision}",)
+        )
         if self._registry.store is not None:
             from ..checkpoint.format import param_fingerprint
 
@@ -295,6 +373,14 @@ class InferenceEngine:
                     param_fingerprint(variables["params"])
                     + param_fingerprint(variables.get("batch_stats", {}))
                     + repr(model)
+                    # Quantized arms only: the f32 digest must stay byte-
+                    # identical to pre-graftprec stores — an upgraded replica
+                    # fleet keeps hydrating its warm f32 entries.
+                    + (
+                        f"|precision={self.precision}"
+                        if self.precision != "f32"
+                        else ""
+                    )
                 ).encode()
             ).hexdigest()
 
@@ -737,7 +823,7 @@ class InferenceEngine:
         return CacheKey.for_environment(
             program="serve_forward",
             config_fingerprint=self._config_fingerprint,
-            flags=(),
+            flags=self._key_flags,
             bucket=bucket,
             args_digest=tree_signature((self._params, self._bstats, batch)),
         )
@@ -1038,6 +1124,131 @@ class InferenceEngine:
             num_graphs_pad=self._g_pad,
             edge_dim=self._edge_dim,
         )
+
+    # ------------------------------------------------------- tolerance gate
+    def _calibration_samples(
+        self, count: int = 4, seed: int = 0
+    ) -> List[GraphSample]:
+        """Deterministic random calibration graphs at the model's feature
+        widths — the default probe batch for :meth:`check_tolerance` when the
+        operator brings no representative samples. Seeded: the gate verdict
+        is reproducible across restarts/replicas."""
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(count):
+            n = int(rng.integers(4, 9))
+            ei = np.stack(
+                [np.arange(n), (np.arange(n) + 1) % n]
+            ).astype(np.int32)
+            ei = np.concatenate([ei, ei[::-1]], axis=1)
+            out.append(
+                GraphSample(
+                    x=rng.normal(size=(n, self.model.input_dim)).astype(
+                        np.float32
+                    ),
+                    pos=np.zeros((n, 3), np.float32),
+                    edge_index=ei,
+                    edge_attr=rng.normal(
+                        size=(ei.shape[1], self._edge_dim)
+                    ).astype(np.float32)
+                    if self._edge_dim
+                    else None,
+                )
+            )
+        return out
+
+    def check_tolerance(self, samples: Optional[Sequence[GraphSample]] = None):
+        """The quantized-arm gate (docs/PRECISION.md): collate one probe
+        batch, run it through BOTH the serving executable (bf16/int8) and a
+        retained f32 reference forward, and compare with the shared tolerance
+        machinery (precision/tolerance.py — the same helpers certify_pallas
+        gates kernels with). Within the bound: returns the verdict report
+        (also folded into ``hydragnn_serve_precision_*`` metrics). Beyond it:
+        raises :class:`PrecisionToleranceError` — a quantized arm that cannot
+        meet its declared tolerance must not take traffic.
+
+        ``precision="f32"`` returns a trivial verdict: the f32 contract is
+        bit-exactness against ``run_prediction`` (tests/test_serve_engine.py),
+        not a tolerance."""
+        import jax
+
+        from ..precision import tolerance_report
+        from ..train.trainer import _apply_model
+
+        if self.precision == "f32":
+            return {
+                "ok": True,
+                "arm": "f32",
+                "note": "bit-exactness contract — no tolerance gate",
+            }
+        if samples is None:
+            samples = self._calibration_samples()
+        else:
+            samples = list(samples)
+            if not samples:
+                # An empty probe set is an upstream bug, not a request for
+                # synthetic calibration — a verdict must never claim coverage
+                # of data it did not see.
+                raise ValueError(
+                    "check_tolerance received an empty sample sequence; pass "
+                    "None for the seeded synthetic calibration batch"
+                )
+        for s in samples:
+            self._validate(s)
+        arena = GraphArena(samples)
+        n_pad, e_pad, _ = self._bucket_shape(
+            int(arena.ns.sum()), int(arena.es.sum())
+        )
+        batch = arena.collate(
+            np.arange(len(samples)),
+            num_nodes_pad=n_pad,
+            num_edges_pad=e_pad,
+            num_graphs_pad=self._g_pad,
+            edge_dim=self._edge_dim,
+        )
+        dev = jax.device_put(batch)
+        quant = [
+            np.asarray(o)
+            for o in jax.block_until_ready(
+                self._jit(self._params, self._bstats, dev)
+            )
+        ]
+        ref_model = self._ref_model
+        ref_vars = self._ref_variables
+        assert ref_model is not None and ref_vars is not None
+        ref_fn = jax.jit(
+            lambda p, b, x: _apply_model(ref_model, p, b, x, train=False)
+        )
+        reference = [
+            np.asarray(o)
+            for o in jax.block_until_ready(
+                ref_fn(
+                    ref_vars["params"], ref_vars.get("batch_stats", {}), dev
+                )
+            )
+        ]
+        report = tolerance_report(
+            quant, reference, self.tolerance, names=self.head_names
+        )
+        report["arm"] = self.precision
+        report["probe_graphs"] = len(samples)
+        if self._quant_report is not None:
+            report["quantization"] = self._quant_report
+        self.metrics.record_precision_gate(report)
+        telemetry.event(
+            "serve/precision_gate",
+            arm=self.precision,
+            ok=report["ok"],
+            fwd_err=report["fwd_err"],
+            tol=report["tol"],
+        )
+        if not report["ok"]:
+            raise PrecisionToleranceError(
+                f"{self.precision} arm diverges from the f32 reference by "
+                f"{report['fwd_err']:.3e} (> tolerance {self.tolerance:g})",
+                report,
+            )
+        return report
 
     # ------------------------------------------------------- checkpoint load
     @classmethod

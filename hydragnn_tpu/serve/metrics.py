@@ -28,6 +28,12 @@ from ..utils.time_utils import Timer
 # engine through multi-minute pathological stalls.
 _DEFAULT_BOUNDS = tuple(1e-4 * (2.0**i) for i in range(25))
 
+# Tolerance-diff bounds for the quantized precision arm (docs/PRECISION.md):
+# 1e-9 .. ~275 in 4x steps — spans bf16 rounding noise on tiny heads through
+# an unmistakably-broken quantization, at the 4x resolution tolerance bounds
+# are stated at.
+_DIFF_BOUNDS = tuple(1e-9 * (4.0**i) for i in range(20))
+
 
 class LatencyHistogram:
     """Fixed-bound histogram of seconds with count/sum and quantile estimates."""
@@ -86,9 +92,18 @@ class LatencyHistogram:
             out[name + "_ms"] = None if v is None else round(v * 1000.0, 3)
         return out
 
-    def prometheus_lines(self, name: str, labels: str = "") -> List[str]:
-        """Cumulative-bucket exposition for one histogram."""
+    def prometheus_lines(
+        self, name: str, labels: str = "", le_fmt=None
+    ) -> List[str]:
+        """Cumulative-bucket exposition for one histogram. ``le_fmt`` formats
+        bound labels; the default (6 decimal places, the historical latency
+        rendering) COLLAPSES sub-1e-6 bounds to "0.0" — histograms with tiny
+        bounds (the precision tolerance-diff family) must pass a
+        significant-digit formatter instead, or strict parsers see duplicate
+        le labels."""
         lab = f"{{{labels}}}" if labels else ""
+        if le_fmt is None:
+            le_fmt = lambda b: repr(round(b, 6))  # noqa: E731
 
         def with_le(le: str) -> str:
             inner = (labels + "," if labels else "") + f'le="{le}"'
@@ -101,7 +116,7 @@ class LatencyHistogram:
         cum = 0
         for b, c in zip(self.bounds, counts):
             cum += c
-            lines.append(f"{name}_bucket{with_le(repr(round(b, 6)))} {cum}")
+            lines.append(f"{name}_bucket{with_le(le_fmt(b))} {cum}")
         lines.append(f"{name}_bucket{with_le('+Inf')} {count}")
         lines.append(f"{name}_sum{lab} {total}")
         lines.append(f"{name}_count{lab} {count}")
@@ -169,6 +184,16 @@ class ServeMetrics:
         # fitter consumes (graphs/packing.py fit_ladder; dump via
         # histogram_json()). Guarded by the same lock as the counters.
         self.size_hist = SizeHistogram()  # guarded-by: self._lock
+        # Precision arm (graftprec, docs/PRECISION.md): which arm this engine
+        # serves, its tolerance bound, and the tolerance-gate record — the
+        # hydragnn_serve_precision_* exposition family.
+        self.precision_arm = "f32"  # guarded-by: self._lock, dirty-reads(set once at engine construction, before worker threads exist)
+        self.precision_tolerance: Optional[float] = None  # guarded-by: self._lock, dirty-reads(same single-assignment lifecycle as precision_arm)
+        self.precision_gate_checks_total = 0  # guarded-by: self._lock
+        self.precision_gate_failures_total = 0  # guarded-by: self._lock
+        self.precision_diff_max = 0.0  # guarded-by: self._lock
+        # Per-head max-abs-diff observations.
+        self.precision_diff = LatencyHistogram(bounds=_DIFF_BOUNDS)  # guarded-by: self._lock, dirty-reads(rebound never after construction; the leaf histogram carries its own lock, like the latency family)
 
     # ------------------------------------------------------------- recorders
     def observe(self, stage: str, seconds: float) -> None:
@@ -200,6 +225,25 @@ class ServeMetrics:
             self.exec_cache_hydrated_total += 1
             self.exec_cache_hydrate_seconds_total += seconds
         Timer.credit("serve_exec_cache_hydrate", seconds)
+
+    def set_precision(self, arm: str, tolerance: Optional[float]) -> None:
+        """Engine-construction registration of the serving arm."""
+        with self._lock:
+            self.precision_arm = str(arm)
+            self.precision_tolerance = tolerance
+
+    def record_precision_gate(self, report: Dict) -> None:
+        """Fold one check_tolerance verdict into the precision family: gate
+        counters, running max diff, and the per-head diff histogram."""
+        with self._lock:
+            self.precision_gate_checks_total += 1
+            if not report.get("ok"):
+                self.precision_gate_failures_total += 1
+            self.precision_diff_max = max(
+                self.precision_diff_max, float(report.get("fwd_err", 0.0))
+            )
+        for head in report.get("per_head", ()):
+            self.precision_diff.observe(float(head["max_abs_diff"]))
 
     def record_request(self, num_nodes: int, num_edges: int) -> None:
         """One admitted request's graph size — the serve half of the size
@@ -257,6 +301,14 @@ class ServeMetrics:
                     ),
                 },
                 "h2d_bytes_total": self.h2d_bytes_total,
+                # Precision arm + tolerance-gate record (docs/PRECISION.md).
+                "precision": {
+                    "arm": self.precision_arm,
+                    "tolerance": self.precision_tolerance,
+                    "gate_checks": self.precision_gate_checks_total,
+                    "gate_failures": self.precision_gate_failures_total,
+                    "max_abs_diff": self.precision_diff_max,
+                },
                 "batch_occupancy_mean": round(
                     self._occupancy_sum / batches, 4
                 )
@@ -291,6 +343,7 @@ class ServeMetrics:
                 },
             }
         out["latency_ms"] = {s: h.snapshot() for s, h in self.latency.items()}
+        out["precision"]["diff"] = self.precision_diff.snapshot()
         return out
 
     def histogram_json(self) -> Dict:
@@ -365,6 +418,36 @@ class ServeMetrics:
                     f'{p}_bucket_node_fill_mean{{bucket="{key}"}} '
                     f"{b['node_fill_mean']}"
                 )
+        # Precision family (docs/PRECISION.md "Telemetry"): which arm serves
+        # (info-style gauge with the arm label), the gate counters, and the
+        # per-head tolerance-diff histogram — empty (all-zero buckets) on
+        # the f32 arm, where no gate runs.
+        prec = snap["precision"]
+        lines.append(f"# TYPE {p}_precision_info gauge")
+        lines.append(f'{p}_precision_info{{arm="{prec["arm"]}"}} 1')
+        lines.append(f"# TYPE {p}_precision_gate_checks_total counter")
+        lines.append(
+            f"{p}_precision_gate_checks_total {prec['gate_checks']}"
+        )
+        lines.append(f"# TYPE {p}_precision_gate_failures_total counter")
+        lines.append(
+            f"{p}_precision_gate_failures_total {prec['gate_failures']}"
+        )
+        if prec["tolerance"] is not None:
+            lines.append(f"# TYPE {p}_precision_tolerance_bound gauge")
+            lines.append(
+                f"{p}_precision_tolerance_bound {prec['tolerance']}"
+            )
+        lines.append(f"# TYPE {p}_precision_tolerance_diff histogram")
+        lines.extend(
+            self.precision_diff.prometheus_lines(
+                f"{p}_precision_tolerance_diff",
+                labels=f'arm="{prec["arm"]}"',
+                # Significant digits, not decimal places: the 1e-9-scale
+                # bounds would otherwise all collapse to le="0.0".
+                le_fmt=lambda b: f"{b:.3g}",
+            )
+        )
         lines.append(f"# TYPE {p}_latency_seconds histogram")
         for stage, hist in self.latency.items():
             lines.extend(

@@ -143,6 +143,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "queue_depth": engine._queue.qsize(),
                     "queue_limit": engine.queue_limit,
                     "compiled_buckets": engine.compiled_buckets,
+                    # Serving arm (docs/PRECISION.md): operators must see at
+                    # a glance whether this replica answers under the
+                    # bit-exactness contract or a tolerance gate.
+                    "precision": engine.precision,
                     "bad_batches": fault_counters["bad_batches_total"],
                     "nonfinite_outputs": fault_counters["nonfinite_total"],
                     "restarts": fault_counters["engine_restarts_total"],

@@ -83,6 +83,8 @@ class TrainingDriver:
         fault_plan=None,
         compile_cache: Optional[str] = None,
         compile_cache_fingerprint: str = "",
+        precision: Optional[str] = None,
+        loss_scale: Optional[dict] = None,
     ):
         from ..faults import FaultPlan, StepGuard
 
@@ -112,6 +114,43 @@ class TrainingDriver:
             if self.fault_plan is not None and self.fault_plan.active
             else None
         )
+        # Precision policy (graftprec, docs/PRECISION.md): Training.precision
+        # = "bf16" clones the model onto its own compute_dtype mechanism (bf16
+        # compute, f32 master weights — trainer._apply_model) and arms dynamic
+        # loss scaling; "f32"/None resolves to no policy object at all, so the
+        # compiled steps below are byte-identical to the seed build.
+        from ..precision import (
+            LossScaleMonitor,
+            PrecisionPolicy,
+            make_loss_scale_state,
+        )
+
+        self.precision = PrecisionPolicy.resolve(precision, loss_scale)
+        self.precision_monitor = None
+        loss_scaling = None
+        if self.precision is not None:
+            if model.compute_dtype is None:
+                model = model.clone(
+                    compute_dtype=self.precision.compute_dtype
+                )
+                self.model = model
+            elif model.compute_dtype != self.precision.compute_dtype:
+                # The runtime mirror of the check-config contradiction gate:
+                # an explicit non-bf16 compute_dtype under precision='bf16'
+                # would silently train at that dtype with pointless loss
+                # scaling armed — never proceed on a lie.
+                raise ValueError(
+                    f"Training.precision='{self.precision.mode}' contradicts "
+                    f"Architecture.compute_dtype={model.compute_dtype!r} — "
+                    "unset compute_dtype (the policy sets it) or pin it to "
+                    f"{self.precision.compute_dtype!r}"
+                )
+            state = state.replace(
+                loss_scale=make_loss_scale_state(self.precision.loss_scale)
+            )
+            self.state = state
+            loss_scaling = self.precision.loss_scale
+            self.precision_monitor = LossScaleMonitor(verbosity)
         guard = self.guard is not None
         if mesh is not None:
             # Each process stacks only its LOCAL slice of the data axis; the
@@ -124,15 +163,20 @@ class TrainingDriver:
             )
             donate = state_donation_safe(state)
             self.train_step = make_train_step_dp(
-                model, optimizer, mesh, donate, guard=guard
+                model, optimizer, mesh, donate, guard=guard,
+                loss_scaling=loss_scaling,
             )
             self.eval_step = make_eval_step_dp(model, mesh)
         else:
             donate = state_donation_safe(state)
-            self.train_step = make_train_step(model, optimizer, donate, guard=guard)
+            self.train_step = make_train_step(
+                model, optimizer, donate, guard=guard,
+                loss_scaling=loss_scaling,
+            )
             self.eval_step = make_eval_step(model)
             self.epoch_scan = make_train_epoch_scan(
-                model, optimizer, donate, guard=guard
+                model, optimizer, donate, guard=guard,
+                loss_scaling=loss_scaling,
             )
         # Chunked lax.scan over the epoch: one device dispatch per chunk
         # instead of per batch (dispatch overhead dominates at HydraGNN's
@@ -200,8 +244,17 @@ class TrainingDriver:
                 ).encode()
             ).hexdigest()
             self._cache_flags = (
-                ("donate",) if donate else ()
-            ) + (("guard",) if guard else ())
+                (("donate",) if donate else ())
+                + (("guard",) if guard else ())
+                # Precision is a program-mode key component: a bf16 step and
+                # the f32 seed step must NEVER hydrate each other's entries
+                # (docs/PRECISION.md "Cache-key interaction").
+                + (
+                    (f"precision={self.precision.mode}",)
+                    if self.precision is not None
+                    else ()
+                )
+            )
         # Whether the 'graph' mesh axis is active (edge arrays then need the
         # P('data','graph') placement the sharded step expects).
         self._graph_sharded = (
@@ -219,6 +272,19 @@ class TrainingDriver:
         # deterministic, dict get/set are single-bytecode atomic under
         # the GIL, and a racing duplicate store just re-memoizes).
         self._sharding_trees: dict = {}  # guarded-by: none(idempotent memo; deterministic value per key; GIL-atomic dict ops; duplicate store is a benign re-memoization)
+
+    # -------------------------------------------------- per-update host hooks
+    def _after_update(self, metrics) -> None:
+        """The host half of the step policies, once per step (streamed path)
+        or per scan chunk: the precision monitor folds the summed overflow/
+        growth metrics into telemetry (train/loss_scale gauge, prec/*
+        counters, backoff flight event), then StepGuard runs its skip/rollback
+        streak accounting — in that order, so a rollback's flight dump
+        already carries the scale movement that preceded it."""
+        if self.precision_monitor is not None:
+            self.precision_monitor.after_update(self, metrics)
+        if self.guard is not None:
+            self.guard.after_update(self, metrics)
 
     # ------------------------------------------------- compiled-step dispatch
     def _dispatch(self, program: str, fn, shape_key, *args):
@@ -456,8 +522,7 @@ class TrainingDriver:
                         )
                         metrics.update(m)
                     bi += 1
-                    if self.guard is not None:
-                        self.guard.after_update(self, m)
+                    self._after_update(m)
                     if profiler:
                         profiler.step()
             finally:
@@ -534,8 +599,7 @@ class TrainingDriver:
                                 self.state, payload, perm, self.rng,
                             )
                         metrics.update(m)
-                    if self.guard is not None:
-                        self.guard.after_update(self, m)
+                    self._after_update(m)
             cached["warm"] = True
             self._credit_timers("train")
             return metrics.averages()
@@ -631,8 +695,7 @@ class TrainingDriver:
                     self.state, payload, self.rng,
                 )
             metrics.update(m)
-        if self.guard is not None:
-            self.guard.after_update(self, m)
+        self._after_update(m)
         if sink is not None:
             nbytes = self._tree_nbytes(payload)
             if sink["bytes"] + nbytes <= self._cache_budget_bytes():

@@ -45,6 +45,10 @@ class TrainState:
     batch_stats: Any
     opt_state: Any
     step: jnp.ndarray
+    # Dynamic loss-scale state (precision/policy.LossScaleState) — present
+    # only under Training.precision="bf16"; None is an empty pytree subtree,
+    # so the f32 state (and every compiled f32 program) is unchanged.
+    loss_scale: Any = None
 
 
 def create_train_state(model, variables, optimizer) -> TrainState:
@@ -142,7 +146,9 @@ def _keep_if(ok, new_tree, old_tree):
     )
 
 
-def _step_body(model: HydraGNN, optimizer, guard: bool = False):
+def _step_body(
+    model: HydraGNN, optimizer, guard: bool = False, loss_scaling=None
+):
     """The single-device gradient step shared by make_train_step and the
     scanned epoch (one definition — the two compiled paths must never drift).
 
@@ -151,10 +157,29 @@ def _step_body(model: HydraGNN, optimizer, guard: bool = False):
     and batch_stats keep their previous values, the step's metrics carry zero
     weight, and ``metrics["bad"]`` reports the skip (summed per chunk on the
     scan path) for the host-side StepGuard policy. guard=False emits exactly
-    the historical computation — the flag costs nothing when disabled."""
+    the historical computation — the flag costs nothing when disabled.
+
+    ``loss_scaling`` (a precision.LossScaleConfig, docs/PRECISION.md) selects
+    the mixed-precision step: the loss is multiplied by the running scale in
+    ``state.loss_scale`` before value_and_grad (bf16's exponent range would
+    otherwise flush small gradients to zero), gradients are unscaled in f32
+    before the optimizer, and the guard's skip machinery is ALWAYS on — an
+    overflowed step must not apply inf/NaN updates — with the scale backing
+    off on overflow and growing after a clean streak, all inside the jit so
+    the policy rides ``lax.scan`` epochs per step. ``None`` emits the
+    historical body byte-for-byte."""
     from ..utils.optimizer import ValueFnTransformation
 
     needs_value_fn = isinstance(optimizer, ValueFnTransformation)
+    if loss_scaling is not None:
+        if needs_value_fn:
+            raise NotImplementedError(
+                "loss scaling + LBFGS is unsupported: the zoom linesearch "
+                "re-evaluates the SCALED loss along the search direction and "
+                "its Wolfe conditions are not scale-invariant under dynamic "
+                "rescaling; use a first-order optimizer with precision='bf16'"
+            )
+        return _scaled_step_body(model, optimizer, guard, loss_scaling)
 
     def body(state: TrainState, batch: GraphBatch, rng):
         dropout_key = jax.random.fold_in(rng, state.step)
@@ -208,6 +233,73 @@ def _step_body(model: HydraGNN, optimizer, guard: bool = False):
             batch_stats=new_bstats,
             opt_state=new_opt,
             step=state.step + 1,
+            loss_scale=state.loss_scale,
+        )
+        return new_state, metrics
+
+    return body
+
+
+def _scaled_step_body(
+    model: HydraGNN, optimizer, guard: bool, loss_scaling
+):
+    """The mixed-precision step (docs/PRECISION.md): scaled loss → f32
+    unscaled grads → guarded (always) update → in-jit dynamic-scale update.
+    Metric semantics mirror the guarded body — an overflowed step carries
+    zero weight, its values are selected away before weighting — plus the
+    precision pair ``overflow`` / ``scale_growths`` (summed per chunk on the
+    scan path) consumed by the host LossScaleMonitor. ``guard`` only adds
+    the ``bad`` metric for StepGuard's streak accounting: the computation is
+    bit-inert to the flag (the skip machinery is structural here)."""
+    from ..precision.policy import loss_scale_update
+
+    def body(state: TrainState, batch: GraphBatch, rng):
+        dropout_key = jax.random.fold_in(rng, state.step)
+        ls = state.loss_scale
+
+        def scaled_loss(p):
+            loss, aux = _loss_and_metrics(
+                model, p, state.batch_stats, batch, dropout_key
+            )
+            # The ONE extra multiply of the policy: everything downstream of
+            # value_and_grad sees gradients of scale*loss; the aux carries
+            # the unscaled loss for metrics.
+            return loss * ls.scale, (loss, aux)
+
+        (_, (loss, (new_bstats, rmses))), sgrads = jax.value_and_grad(
+            scaled_loss, has_aux=True
+        )(state.params)
+        inv = 1.0 / ls.scale
+        # Unscale in the grads' own (f32 master) dtype: inf/NaN from an
+        # overflowed backward survive the divide, so the finite check below
+        # sees them; finite grads come out exactly scale-free.
+        grads = jax.tree_util.tree_map(lambda g: g * inv, sgrads)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p + u, state.params, updates
+        )
+        ok = _all_finite(loss, grads)
+        new_params = _keep_if(ok, new_params, state.params)
+        new_opt = _keep_if(ok, new_opt, state.opt_state)
+        new_bstats = _keep_if(ok, new_bstats, state.batch_stats)
+        new_ls, grew = loss_scale_update(ls, ok, loss_scaling)
+        okf = ok.astype(jnp.float32)
+        count = batch.count_real_graphs().astype(jnp.float32) * okf
+        metrics = {
+            "loss": jnp.where(ok, loss, 0.0) * count,
+            "rmses": jnp.where(ok, rmses, jnp.zeros_like(rmses)) * count,
+            "count": count,
+            "overflow": 1.0 - okf,
+            "scale_growths": grew.astype(jnp.float32),
+        }
+        if guard:
+            metrics["bad"] = 1.0 - okf
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_bstats,
+            opt_state=new_opt,
+            step=state.step + 1,
+            loss_scale=new_ls,
         )
         return new_state, metrics
 
@@ -215,9 +307,13 @@ def _step_body(model: HydraGNN, optimizer, guard: bool = False):
 
 
 def make_train_step(
-    model: HydraGNN, optimizer, donate: bool = True, guard: bool = False
+    model: HydraGNN,
+    optimizer,
+    donate: bool = True,
+    guard: bool = False,
+    loss_scaling=None,
 ) -> Callable:
-    body = _step_body(model, optimizer, guard)
+    body = _step_body(model, optimizer, guard, loss_scaling)
 
     # donate_argnums: params/opt_state buffers are reused in place, halving
     # HBM traffic for the state update (callers must drop the old state).
@@ -253,7 +349,11 @@ def make_eval_step(model: HydraGNN) -> Callable:
 
 
 def make_train_epoch_scan(
-    model: HydraGNN, optimizer, donate: bool = True, guard: bool = False
+    model: HydraGNN,
+    optimizer,
+    donate: bool = True,
+    guard: bool = False,
+    loss_scaling=None,
 ) -> Callable:
     """Whole-epoch driver: one compiled call scans the train step over a
     stacked batch array [S, ...] (single dispatch per epoch instead of per
@@ -262,9 +362,11 @@ def make_train_epoch_scan(
     over steps, matching EpochMetrics' weighted accumulation. With ``guard``,
     the per-step skip rides INSIDE the scan (a NaN step never poisons later
     steps of the same chunk) and the summed ``bad`` metric reports how many
-    steps were skipped."""
+    steps were skipped. With ``loss_scaling`` the dynamic-scale state rides
+    the scan carry (TrainState.loss_scale), so backoff/growth stay exact per
+    step even inside a single-dispatch epoch."""
 
-    body = _step_body(model, optimizer, guard)
+    body = _step_body(model, optimizer, guard, loss_scaling)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def epoch(state: TrainState, batches: GraphBatch, rng):
@@ -308,7 +410,12 @@ def _batch_pspec(batch: GraphBatch, graph_sharded: bool) -> GraphBatch:
 
 
 def make_train_step_dp(
-    model: HydraGNN, optimizer, mesh, donate: bool = True, guard: bool = False
+    model: HydraGNN,
+    optimizer,
+    mesh,
+    donate: bool = True,
+    guard: bool = False,
+    loss_scaling=None,
 ) -> Callable:
     """SPMD step over a ('data', 'graph') mesh. ``batch`` arrays carry a leading
     device axis [D, ...] dealt over 'data'; when the model was built with
@@ -326,6 +433,14 @@ def make_train_step_dp(
             "zoom linesearch would evaluate per-shard losses and diverge "
             "across devices. Use a first-order optimizer (AdamW) for "
             "distributed runs, or LBFGS on a single device."
+        )
+    if loss_scaling is not None:
+        raise NotImplementedError(
+            "Training.precision='bf16' (dynamic loss scaling) is not wired "
+            "into the mesh step yet: the scale state machine must update in "
+            "lockstep after the gradient psum (ROADMAP item 3 — lands with "
+            "the distributed-harness work of item 2). On a mesh, use "
+            "Architecture.compute_dtype='bfloat16' for compute-only bf16."
         )
     graph_sharded = model.graph_axis is not None and mesh.shape.get("graph", 1) > 1
     grad_axes = ("data", "graph") if graph_sharded else ("data",)
@@ -386,6 +501,7 @@ def make_train_step_dp(
             batch_stats=new_bstats,
             opt_state=new_opt,
             step=state.step + 1,
+            loss_scale=state.loss_scale,
         )
         return new_state, metrics
 

@@ -197,3 +197,46 @@ def pytest_committed_compile_cache_artifact_readable():
     blk = _last_known_compile_cache(repo)
     assert blk is not None
     assert blk["value"] >= 5.0 and blk["bit_exact_warm_vs_cold"] is True
+
+
+def pytest_last_known_precision_picks_latest_real_round(tmp_path):
+    from bench import _last_known_precision
+
+    real = {
+        "metric": "precision_ab",
+        "value": 1.42,
+        "unit": "f32_over_bf16_policy_steady_window_time",
+        "timings_meaningful": True,
+        "convergence": {"ok": True},
+        "serve": {"bf16": {"gate_ok": True}, "int8": {"gate_ok": True}},
+        "backend": "tpu",
+    }
+    (tmp_path / "PRECISION_r11.json").write_text(json.dumps(real))
+    # A failed --precision round carries value 0.0 — never "last known".
+    (tmp_path / "PRECISION_r12.json").write_text(
+        json.dumps({"metric": "precision_ab", "value": 0.0,
+                    "error": "TimeoutError"})
+    )
+    now = time.time()
+    os.utime(tmp_path / "PRECISION_r11.json", (now - 50, now - 50))
+    os.utime(tmp_path / "PRECISION_r12.json", (now - 10, now - 10))
+
+    blk = _last_known_precision(str(tmp_path))
+    assert blk is not None
+    assert blk["value"] == 1.42
+    assert blk["convergence_ok"] is True
+    assert blk["serve_arms_ok"] is True
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "PRECISION_r11.json"
+
+
+def pytest_committed_precision_artifact_readable():
+    """The committed PRECISION_r* round is a valid last-known block with the
+    acceptance gates green (step-matched convergence, quantized serve)."""
+    from bench import _last_known_precision
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_precision(repo)
+    assert blk is not None
+    assert blk["convergence_ok"] is True
+    assert blk["serve_arms_ok"] is True
