@@ -493,6 +493,33 @@ def _last_known_serving(search_dir: "str | None" = None) -> "dict | None":
     return _latest_artifact_block("SERVE_*.json", extract, search_dir)
 
 
+def _last_known_router(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent completed multi-replica rig from any committed ROUTER_*
+    artifact — the router analog of ``_last_known_hardware``. A failed
+    ``--router`` round embeds this block with ``provenance: "stale"`` so an
+    rc=1 round still carries the last-known-good fleet drill record."""
+
+    def extract(doc):
+        kill = doc.get("kill_replica_drill") or {}
+        scale = doc.get("scaleup_drill") or {}
+        if not doc.get("open_loop") or not kill:
+            return None
+        top = doc["open_loop"][-1]
+        return {
+            "replicas": doc.get("replicas"),
+            "fleet_p99_ms_at_top_load": top.get("fleet_p99_ms"),
+            "offered_graphs_per_sec_top": top.get("offered_graphs_per_sec"),
+            "kill_drill_zero_lost": kill.get("zero_lost"),
+            "scaleup_warmup_xla_compiles": (
+                scale.get("warm_spinup") or {}
+            ).get("warmup_xla_compiles"),
+            "platform": doc.get("platform"),
+            "device_kind": doc.get("device_kind"),
+        }
+
+    return _latest_artifact_block("ROUTER_*.json", extract, search_dir)
+
+
 def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
     """Most recent completed drill matrix from any committed FAULTS_*
     artifact — the fault-drill analog of ``_last_known_hardware``. A failed
@@ -1522,6 +1549,54 @@ def serve_main() -> int:
     return 0
 
 
+def router_main() -> int:
+    """``python bench.py --router``: run the multi-replica router rig
+    (benchmarks/serve_load.py run_router_benchmark — fleet open-loop sweep,
+    kill-a-replica drill, scale-up-under-load drill) and print its block as
+    the round's ROUTER JSON line. Failure embeds the last known router
+    measurement (stale-labeled), mirroring the other bench arms."""
+    result = {
+        "metric": "router_fleet_p99_ms_at_top_load",
+        "value": 0.0,
+        "unit": "ms",
+    }
+    try:
+        import jax
+
+        _with_retries(_probe_device)
+        result["backend"] = jax.default_backend()
+        result["device_kind"] = jax.devices()[0].device_kind
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.serve_load import run_router_benchmark
+
+        block = _with_retries(run_router_benchmark)
+        result["value"] = block["open_loop"][-1]["fleet_p99_ms"]
+        result["kill_drill_zero_lost"] = block["kill_replica_drill"][
+            "zero_lost"
+        ]
+        result["scaleup_warmup_xla_compiles"] = block["scaleup_drill"][
+            "warm_spinup"
+        ]["warmup_xla_compiles"]
+        result["router"] = block
+        result["retries"] = _RETRIES_USED
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        result["retries"] = _RETRIES_USED
+        try:
+            stale = _last_known_router()
+            if stale is not None:
+                result["last_known_router"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
 def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
     'remote_compile: Connection refused') or probe timeouts — retryable;
@@ -1765,6 +1840,8 @@ def main():
 if __name__ == "__main__":
     if "--serve" in sys.argv:
         sys.exit(serve_main())
+    if "--router" in sys.argv:
+        sys.exit(router_main())
     if "--faults" in sys.argv:
         sys.exit(faults_main())
     if "--packing" in sys.argv:

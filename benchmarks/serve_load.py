@@ -35,6 +35,18 @@ artifact labels the platform.
 
     python benchmarks/serve_load.py [--duration 1.5] [--loads 50,200,800]
         [--no-ab]
+
+Since the graftroute PR (ISSUE 12) this module is ALSO the multi-replica
+open-loop rig: ``run_router_benchmark`` drives a replica fleet through the
+front router (fleet-level p50/p95/p99 vs offered load), a kill-a-replica
+drill (one replica poisoned mid-load via the faults layer's InjectedFault;
+zero lost accepted requests), and a scale-up-under-load drill (a new
+replica hydrating its whole ladder from the shared graftcache store, with
+a compile spy proving zero XLA compiles) — emitting ``ROUTER_rNN.json``
+via ``bench.py --router``.
+
+    python benchmarks/serve_load.py --router [--duration 1.5]
+        [--loads 25,100,300] [--replicas 2]
 """
 
 from __future__ import annotations
@@ -405,10 +417,338 @@ def run_serve_benchmark(
     return block
 
 
+# ---------------------------------------------------------------------------
+# Multi-replica router rig (graftroute, ISSUE 12 / ROADMAP item 1)
+# ---------------------------------------------------------------------------
+def build_router_fleet(
+    n_replicas: int = 2,
+    compile_cache: "str | None" = None,
+    health_interval_s: float = 0.1,
+    **engine_kw,
+):
+    """A router over N bit-identical in-process engine replicas sharing one
+    bucket ladder (and, when given, one graftcache store). Returns
+    ``(router, engines, graphs, timings)`` — ``timings`` carries each
+    replica's warmup wall + compile-spy count (zero on a hydrated store)."""
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    engines, timings = [], []
+    graphs = None
+    for i in range(n_replicas):
+        timing: dict = {}
+        engine, pool = build_serving_engine(
+            compile_cache=compile_cache, timing=timing, **engine_kw
+        )
+        engines.append(engine)
+        timings.append(timing)
+        graphs = pool
+    router = Router(
+        [InProcessReplica(f"replica-{i}", e) for i, e in enumerate(engines)],
+        health_interval_s=health_interval_s,
+        jitter_seed=0,
+    )
+    return router, engines, graphs, timings
+
+
+def router_open_loop(
+    router,
+    graphs,
+    offered_rps: float,
+    duration_s: float = 1.5,
+    klass: str = "fast",
+    mid_load_hook=None,
+) -> dict:
+    """Open-loop arrivals through the router: one dispatcher thread per
+    request (router.predict is synchronous — the replica futures do the
+    waiting). Every accepted request resolves to an EXPLICIT outcome
+    (ok / busy / unavailable / timeout / error-typed) — the zero-silent-loss
+    accounting the kill drill gates on. ``mid_load_hook`` fires once at
+    ~duration/3 (the drills inject their fault/scale-up there)."""
+    from hydragnn_tpu.route import NoReplicaAvailableError, RouterBusyError
+
+    interval = 1.0 / offered_rps
+    n = max(1, int(duration_s * offered_rps))
+    outcomes: list = [None] * n
+    latencies: list = [None] * n
+
+    def one(i: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            router.predict(
+                [graphs[i % len(graphs)]], klass=klass, request_id=f"rig-{i}"
+            )
+            outcomes[i] = "ok"
+            latencies[i] = time.perf_counter() - t0
+        except RouterBusyError:
+            outcomes[i] = "busy"
+        except NoReplicaAvailableError:
+            outcomes[i] = "unavailable"
+        except TimeoutError:
+            outcomes[i] = "timeout"
+        except Exception as e:  # noqa: BLE001 — typed, never silent
+            outcomes[i] = f"error:{type(e).__name__}"
+
+    hook_at = n // 3
+    threads = []
+    t0 = time.perf_counter()
+    for i in range(n):
+        target = t0 + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if mid_load_hook is not None and i == hook_at:
+            mid_load_hook()
+        t = threading.Thread(target=one, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(120)
+    elapsed = time.perf_counter() - t0
+    done = [s for s in latencies if s is not None]
+    done.sort()
+
+    def q(p):
+        return (
+            round(done[min(len(done) - 1, int(p * len(done)))] * 1000.0, 3)
+            if done
+            else None
+        )
+
+    counts: dict = {}
+    for o in outcomes:
+        key = o if o is not None else "lost"
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "mode": "router_open",
+        "class": klass,
+        "offered_graphs_per_sec": offered_rps,
+        "offered": n,
+        "completed": len(done),
+        "achieved_graphs_per_sec": round(len(done) / elapsed, 2),
+        "outcomes": counts,
+        # Zero-silent-loss accounting: every request has an explicit
+        # outcome; "lost" (no outcome after join) must be 0.
+        "lost": counts.get("lost", 0),
+        "fleet_p50_ms": q(0.50),
+        "fleet_p95_ms": q(0.95),
+        "fleet_p99_ms": q(0.99),
+    }
+
+
+def kill_replica_drill(duration_s: float, rps: float) -> dict:
+    """Kill-a-replica under load: one replica's engine is poisoned mid-load
+    through the faults taxonomy (InjectedFault as a fatal worker error —
+    the same class the training drills inject), the router drains it on the
+    first dispatch-observed failure, and the health loop ejects it. Gate:
+    zero lost accepted requests — in-flight work is retried on the
+    surviving replica or failed with an explicit retryable status."""
+    from hydragnn_tpu.faults import InjectedFault
+
+    router, engines, graphs, _ = build_router_fleet(n_replicas=2)
+    try:
+        steady = router_open_loop(router, graphs, rps, duration_s)
+
+        def kill():
+            # Fatal worker error outside the restart budget -> poisoned
+            # engine: submits fail with EngineFailedError (ReplicaDown at
+            # the router) and in-flight futures fail loudly.
+            engines[0]._fail(InjectedFault("drill: replica-0 killed"))
+
+        drill = router_open_loop(
+            router, graphs, rps, duration_s, mid_load_hook=kill
+        )
+        time.sleep(router.health_interval_s * 3)  # let the loop confirm
+        states = {k: v["state"] for k, v in router.states().items()}
+        return {
+            "steady": steady,
+            "drill": drill,
+            "killed_replica_state": states["replica-0"],
+            "survivor_state": states["replica-1"],
+            "zero_lost": steady["lost"] == 0 and drill["lost"] == 0,
+            "fleet_p99_steady_ms": steady["fleet_p99_ms"],
+            "fleet_p99_drill_ms": drill["fleet_p99_ms"],
+            "router_metrics": router.metrics.snapshot(),
+        }
+    finally:
+        router.close()
+        for e in engines:
+            e.close()
+
+
+def scaleup_drill(duration_s: float, rps: float, cache_dir: str) -> dict:
+    """Scale-up under load over the shared graftcache store: the fleet
+    starts at ONE replica (its cold warmup populates the store), a second
+    replica spins up mid-load, hydrates its whole ladder from the store
+    (compile spy: zero XLA compiles), and is admitted only once hydrated.
+    Also certifies the admitted replica bit-exact against a direct engine
+    at matched bucket shapes."""
+    import numpy as np
+
+    from hydragnn_tpu.analysis.sentinel import compile_count
+    from hydragnn_tpu.route import InProcessReplica
+
+    router, engines, graphs, timings = build_router_fleet(
+        n_replicas=1, compile_cache=cache_dir
+    )
+    spawned: dict = {}
+    try:
+        t_spawn: dict = {}
+
+        def scale_up():
+            def factory():
+                timing: dict = {}
+                t0 = time.perf_counter()
+                engine, _ = build_serving_engine(
+                    compile_cache=cache_dir, timing=timing
+                )
+                timing["build_wall_s"] = round(time.perf_counter() - t0, 4)
+                spawned["engine"] = engine
+                spawned["timing"] = timing
+                return InProcessReplica("replica-1", engine)
+
+            t_spawn["t0"] = time.perf_counter()
+            router.scale_up(
+                "replica-1", factory, expected_rungs=len(engines[0]._ladder)
+            )
+
+        c0 = compile_count()
+        drill = router_open_loop(
+            router, graphs, rps, duration_s, mid_load_hook=scale_up
+        )
+        # Wait for admission (spawn + hydration + one health poll).
+        t_admit = None
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:
+            if router.states().get("replica-1", {}).get("state") == "admitted":
+                t_admit = time.perf_counter() - t_spawn["t0"]
+                break
+            time.sleep(0.02)
+        post = router_open_loop(router, graphs, rps, duration_s)
+
+        block = {
+            "drill": drill,
+            "post_scaleup": post,
+            "cold_warmup": timings[0],
+            "xla_compiles_during_drill_window": compile_count() - c0,
+            "admitted": t_admit is not None,
+            "zero_lost": drill["lost"] == 0 and post["lost"] == 0,
+        }
+        if "engine" not in spawned:
+            # Spawn failed (factory raised): the drill's own diagnostic
+            # record — admitted False plus the router's view — must land in
+            # the artifact instead of a KeyError aborting the whole bench.
+            block["warm_spinup"] = {"spawn_failed": True}
+            block["spawn_replica_state"] = (
+                router.states().get("replica-1") or {}
+            ).get("state")
+            block["bitexact_vs_direct"] = None
+            return block
+        # Bit-exactness at matched buckets: the hydrated replica's answers
+        # vs a direct single engine (replica-0 shares its executables).
+        bitexact = True
+        for i, g in enumerate(graphs[:4]):
+            want = engines[0].predict([g])[0]
+            got = spawned["engine"].predict([g])[0]
+            bitexact = bitexact and all(
+                np.array_equal(np.asarray(w), np.asarray(o))
+                for w, o in zip(want, got)
+            )
+        hydr = spawned["engine"].metrics.read_counters(
+            "exec_cache_hydrated_total", "cache_misses_total"
+        )
+        block["warm_spinup"] = {
+            "build_wall_s": spawned["timing"].get("build_wall_s"),
+            "hydration_wall_s": spawned["timing"].get("warmup_wall_s"),
+            "warmup_xla_compiles": spawned["timing"].get(
+                "warmup_xla_compiles"
+            ),
+            "buckets_hydrated": hydr["exec_cache_hydrated_total"],
+            "buckets_compiled_fresh": hydr["cache_misses_total"],
+            "time_to_admit_s": round(t_admit, 4) if t_admit else None,
+        }
+        block["bitexact_vs_direct"] = bitexact
+        return block
+    finally:
+        router.close()
+        for e in engines:
+            e.close()
+        if "engine" in spawned:
+            spawned["engine"].close()
+
+
+def run_router_benchmark(
+    duration_s: float = 1.5,
+    loads=(25.0, 100.0, 300.0),
+    out_path: "str | None" = None,
+    n_replicas: int = 2,
+) -> dict:
+    """The multi-replica serving artifact (``ROUTER_rNN.json``): fleet-level
+    open-loop latency vs offered load, the kill-a-replica drill, and the
+    scale-up-under-load drill (ROADMAP item 1's acceptance drills)."""
+    import tempfile
+
+    import jax
+
+    block = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": "PNA hidden=8 x2 (graph+node heads)",
+        "replicas": n_replicas,
+        "note": "CPU runs measure routing/serving plumbing (admission, "
+        "hashing, retry, health), not TPU latency",
+    }
+
+    # Fleet-level p50/p95/p99 vs offered load.
+    router, engines, graphs, _ = build_router_fleet(n_replicas=n_replicas)
+    try:
+        with engines[0].no_recompile(action="count") as watch:
+            block["open_loop"] = [
+                router_open_loop(router, graphs, rps, duration_s)
+                for rps in loads
+            ]
+        block["xla_compiles_during_load"] = watch.count
+        block["router_metrics"] = router.metrics.snapshot()
+    finally:
+        router.close()
+        for e in engines:
+            e.close()
+
+    block["kill_replica_drill"] = kill_replica_drill(duration_s, loads[0])
+    with tempfile.TemporaryDirectory() as cache_dir:
+        block["scaleup_drill"] = scaleup_drill(
+            duration_s, loads[0], cache_dir
+        )
+
+    # graftel census: the routed request trail (route/* spans + events).
+    from hydragnn_tpu import telemetry
+
+    counts = telemetry.span_counts(telemetry.snapshot_records())
+    block["telemetry"] = {
+        "span_counts": {
+            name: n
+            for name, n in sorted(counts.items())
+            if name.startswith("route/")
+        }
+    }
+
+    if out_path is None:
+        out_path = os.path.join(REPO, f"ROUTER_r{round_tag()}.json")
+    with open(out_path, "w") as f:
+        json.dump(block, f, indent=2)
+    block["artifact"] = os.path.basename(out_path)
+    return block
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=1.5)
-    ap.add_argument("--loads", default="50,200,800")
+    ap.add_argument(
+        "--loads",
+        default=None,
+        help="offered-rate sweep, comma-separated graphs/sec "
+        "(default: 50,200,800 for the engine A/B; 25,100,300 for --router)",
+    )
     ap.add_argument("--out", default=None)
     ap.add_argument(
         "--no-ab",
@@ -423,11 +763,31 @@ def main() -> int:
         help="bind the graftcache executable store: a second run over the "
         "same ladder warms up by hydration (per-arm warmup.wall_s shows it)",
     )
+    ap.add_argument(
+        "--router",
+        action="store_true",
+        help="run the multi-replica router rig instead (fleet open-loop "
+        "sweep + kill-a-replica + scale-up-under-load; ROUTER_rNN.json)",
+    )
+    ap.add_argument("--replicas", type=int, default=2)
     args = ap.parse_args()
-    loads = tuple(float(v) for v in args.loads.split(",") if v.strip())
+    loads = (
+        tuple(float(v) for v in args.loads.split(",") if v.strip())
+        if args.loads
+        else None
+    )
+    if args.router:
+        block = run_router_benchmark(
+            duration_s=args.duration,
+            loads=loads or (25.0, 100.0, 300.0),
+            out_path=args.out,
+            n_replicas=args.replicas,
+        )
+        print(json.dumps(block))
+        return 0
     block = run_serve_benchmark(
         duration_s=args.duration,
-        loads=loads,
+        loads=loads or (50.0, 200.0, 800.0),
         out_path=args.out,
         ab=not args.no_ab,
         max_rungs=args.max_rungs,

@@ -1,4 +1,4 @@
-"""Deterministic tsan drill over the serve + async-checkpoint paths.
+"""Deterministic tsan drill over the serve + route + async-checkpoint paths.
 
 Runs the two concurrency-heavy subsystems with graftrace's runtime
 sanitizer enabled (analysis/tsan.py): every registered lock records its
@@ -194,6 +194,47 @@ def _telemetry_drill(tmpdir: str) -> None:
     telemetry.configure(collect=False)
 
 
+def _route_drill() -> None:
+    """graftroute path (ISSUE 12): the router's health loop + caller-thread
+    dispatch + a dispatch-observed failure drain, all under instrumentation
+    — Router._lock / RouteMetrics._lock / the ring's external-guard contract
+    race the engine and telemetry locks exactly as in production. The
+    drill's dispatch site (``route.dispatch.pre_send``) perturbs the window
+    between target acquisition and the replica call."""
+    from benchmarks.serve_load import build_serving_engine
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    engines = []
+    replicas = []
+    for i in range(2):
+        engine, graphs = build_serving_engine(
+            hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0,
+            pool_size=_SERVE_REQUESTS,
+        )
+        engines.append(engine)
+        replicas.append(InProcessReplica(f"drill-{i}", engine))
+    router = Router(
+        replicas,
+        health_interval_s=0.02,
+        jitter_seed=0,
+        autostart_health=True,
+    )
+    try:
+        for i in range(_SERVE_REQUESTS):
+            router.predict([graphs[i]], request_id=f"route-drill-{i}")
+        # Kill one replica mid-fleet: dispatch observes the death, drains it
+        # (the health loop racing the same table), and retries elsewhere.
+        engines[0].close()
+        for i in range(_SERVE_REQUESTS):
+            router.predict([graphs[i]], request_id=f"route-drill2-{i}")
+        router.poll_health()  # the /healthz cross-thread read
+        router.metrics.render_prometheus()  # the /metrics cross-thread read
+    finally:
+        router.close()
+        for engine in engines:
+            engine.close()
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -202,6 +243,7 @@ def run_drill(seed: int) -> dict:
         _serve_drill()
         _telemetry_drill(tmpdir)
         _cache_drill(tmpdir)
+        _route_drill()
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

@@ -21,6 +21,10 @@ Every failure is one actionable line tagged with a stable code:
                     nonsense (unknown arm, int8 for training, non-positive
                     scale knobs, quantized serve without a tolerance bound)
   oob-bucket        a bucket/batch/ladder size cannot hold the data
+  bad-router        multi-replica router config nonsense (replica count /
+                    hash-ring weights / admission classes without deadlines /
+                    fleet ladder-memory blowout) — docs/SERVING.md
+                    "Multi-replica tier"
   donation-misuse   config requests a donating step that would alias buffers
   shape-mismatch    eval_shape found inconsistent shapes/dtypes end to end
 
@@ -77,6 +81,7 @@ def check_config(
     deep: bool = True,
     serve_precision: Optional[str] = None,
     serve_tolerance: Optional[float] = None,
+    router: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Validate a training or serving config statically. Returns the report
     dict; with ``strict`` (the default) raises :class:`ConfigContractError`
@@ -87,7 +92,10 @@ def check_config(
     ``"auto:<path>"`` (resolved via graphs/packing.resolve_ladder_spec).
     ``serve_precision``/``serve_tolerance`` are the serve CLI's arm flags
     (docs/PRECISION.md): quantized arms without a positive tolerance bound
-    are a ``bad-precision`` finding here, before the checkpoint loads."""
+    are a ``bad-precision`` finding here, before the checkpoint loads.
+    ``router`` is the front-router config dict (the route CLI passes
+    ``{"replicas", "classes", "load_factor", "vnodes", ...}``); router
+    nonsense is a ``bad-router`` finding through this same gate."""
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
@@ -108,6 +116,8 @@ def check_config(
         arch, training, mode, serve_precision, serve_tolerance, errors
     )
     _check_buckets(config, arch, training, bucket_ladder, mode, errors)
+    if router is not None:
+        _check_router(router, bucket_ladder, errors)
     _check_donation(training, errors)
     _check_aggregation_path(arch, errors)
 
@@ -168,6 +178,7 @@ def gate_config(
     deep=True,
     serve_precision=None,
     serve_tolerance=None,
+    router=None,
 ):
     """The ONE entry-point gate shared by run_training / run_prediction /
     serve startup: honors ``HYDRAGNN_CHECK_CONFIG`` (``full`` default,
@@ -186,6 +197,7 @@ def gate_config(
         deep=deep and level != "structural",
         serve_precision=serve_precision,
         serve_tolerance=serve_tolerance,
+        router=router,
     )
 
 
@@ -515,6 +527,145 @@ def _check_precision(
 
 
 # -------------------------------------------------------------------- buckets
+def _check_router(router, bucket_ladder, errors):
+    """Front-router config contract (docs/SERVING.md "Multi-replica tier"):
+    replica-count / hash-ring-weight / admission-class nonsense and a
+    fleet-wide ladder-memory blowout (every replica compiles or hydrates
+    the WHOLE bucket ladder — N replicas x R rungs executables resident)
+    are one actionable ``bad-router`` line before any engine is built."""
+    import math
+
+    replicas = router.get("replicas", 1)
+    n_replicas = None
+    if isinstance(replicas, int) and not isinstance(replicas, bool):
+        n_replicas = replicas
+        if replicas < 1:
+            errors.append(
+                (
+                    "bad-router",
+                    f"router needs at least 1 replica, got {replicas}",
+                )
+            )
+    elif isinstance(replicas, (list, tuple)):
+        n_replicas = len(replicas)
+        if not replicas:
+            errors.append(("bad-router", "router replica list is empty"))
+        for i, spec in enumerate(replicas):
+            weight = (
+                spec.get("weight", 1.0) if isinstance(spec, dict) else spec
+            )
+            try:
+                w = float(weight)
+            except (TypeError, ValueError):
+                w = float("nan")
+            if not math.isfinite(w) or w <= 0:
+                errors.append(
+                    (
+                        "bad-router",
+                        f"replica #{i} hash-ring weight must be a positive "
+                        f"finite number, got {weight!r}",
+                    )
+                )
+    else:
+        errors.append(
+            (
+                "bad-router",
+                f"router 'replicas' must be a count or a list, got "
+                f"{type(replicas).__name__}",
+            )
+        )
+
+    classes = router.get("classes")
+    if classes is not None:
+        if not isinstance(classes, dict) or not classes:
+            errors.append(
+                (
+                    "bad-router",
+                    "router 'classes' must be a non-empty mapping of "
+                    "admission-class name -> {deadline_s}",
+                )
+            )
+        else:
+            for name, spec in classes.items():
+                deadline = (
+                    spec.get("deadline_s")
+                    if isinstance(spec, dict)
+                    else spec
+                )
+                try:
+                    d = float(deadline)
+                except (TypeError, ValueError):
+                    d = float("nan")
+                if not math.isfinite(d) or d <= 0:
+                    errors.append(
+                        (
+                            "bad-router",
+                            f"admission class {name!r} has no positive "
+                            f"finite deadline_s (got {deadline!r}) — an SLO "
+                            "class without a deadline cannot shed load",
+                        )
+                    )
+
+    load_factor = router.get("load_factor", 1.25)
+    try:
+        lf = float(load_factor)
+    except (TypeError, ValueError):
+        lf = float("nan")
+    if not math.isfinite(lf) or lf < 1.0:
+        errors.append(
+            (
+                "bad-router",
+                f"load_factor must be a finite number >= 1 (bounded-load "
+                f"consistent hashing), got {load_factor!r}",
+            )
+        )
+
+    vnodes = router.get("vnodes", 64)
+    if not isinstance(vnodes, int) or isinstance(vnodes, bool) or vnodes < 1:
+        errors.append(
+            ("bad-router", f"vnodes must be an integer >= 1, got {vnodes!r}")
+        )
+
+    # Fleet ladder memory: resolve the rung count when a ladder is known.
+    rungs = None
+    if isinstance(bucket_ladder, str):
+        try:
+            from ..graphs.packing import resolve_ladder_spec
+
+            rungs = len(resolve_ladder_spec(bucket_ladder))
+        except Exception:  # noqa: BLE001 — _check_buckets reports the spec
+            rungs = None
+    elif bucket_ladder is not None:
+        try:
+            rungs = len(list(bucket_ladder))
+        except TypeError:
+            rungs = None
+    max_fleet_buckets = router.get("max_fleet_buckets", 128)
+    if (
+        not isinstance(max_fleet_buckets, int)
+        or isinstance(max_fleet_buckets, bool)
+        or max_fleet_buckets < 1
+    ):
+        errors.append(
+            (
+                "bad-router",
+                "max_fleet_buckets must be an integer >= 1, got "
+                f"{max_fleet_buckets!r}",
+            )
+        )
+        max_fleet_buckets = 128
+    if rungs and n_replicas and n_replicas * rungs > max_fleet_buckets:
+        errors.append(
+            (
+                "bad-router",
+                f"{n_replicas} replicas x {rungs} ladder rungs = "
+                f"{n_replicas * rungs} resident executables exceeds the "
+                f"fleet budget {max_fleet_buckets} — shrink the ladder, "
+                "the fleet, or raise router.max_fleet_buckets",
+            )
+        )
+
+
 def _check_buckets(config, arch, training, bucket_ladder, mode, errors):
     bs = training.get("batch_size")
     if bs is not None and (not isinstance(bs, int) or bs < 1):

@@ -1,0 +1,57 @@
+"""graftroute — multi-replica serving tier (docs/SERVING.md "Multi-replica
+tier"; ROADMAP item 1).
+
+A stdlib-only front router over N ``InferenceEngine`` replicas: consistent
+request hashing with bounded-load spill (ring.py), per-class SLO-aware
+admission and deadline-based load shedding (admission.py), a health loop
+consuming each replica's /healthz sticky-degraded states to
+drain/eject/readmit, and warm scale-up over the shared graftcache store
+(router.py). Replica backends — in-process engines and HTTP/subprocess
+serve processes — sit behind one ``Replica`` interface (replica.py); the
+HTTP front end (server.py) and the ``hydragnn_route_*`` metric family
+(metrics.py) mirror the single-engine serve layer.
+
+CLI: ``python -m hydragnn_tpu.serve router --config ... --replicas N``
+(also reachable as ``python -m hydragnn_tpu.route``).
+"""
+
+from .admission import (
+    DEFAULT_CLASSES,
+    AdmissionClass,
+    NoReplicaAvailableError,
+    RouterBusyError,
+    build_classes,
+)
+from .metrics import RouteMetrics
+from .replica import (
+    HttpReplica,
+    InProcessReplica,
+    Replica,
+    ReplicaBackpressureError,
+    ReplicaDownError,
+    ReplicaError,
+    spawn_serve_replica,
+)
+from .ring import HashRing
+from .router import RouteResult, Router
+from .server import RouterServer
+
+__all__ = [
+    "DEFAULT_CLASSES",
+    "AdmissionClass",
+    "HashRing",
+    "HttpReplica",
+    "InProcessReplica",
+    "NoReplicaAvailableError",
+    "Replica",
+    "ReplicaBackpressureError",
+    "ReplicaDownError",
+    "ReplicaError",
+    "RouteMetrics",
+    "RouteResult",
+    "Router",
+    "RouterBusyError",
+    "RouterServer",
+    "build_classes",
+    "spawn_serve_replica",
+]

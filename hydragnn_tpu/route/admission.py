@@ -1,0 +1,132 @@
+"""Admission classes and SLO-aware load shedding for the front router
+(docs/SERVING.md "Multi-replica tier").
+
+The single-engine 429 path says "my queue is full, retry in ~Ns". Fleet-wide
+that hint is meaningless: one replica's queue says nothing about the tier's
+capacity, and N synchronized clients retrying at exactly +Ns thundering-herd
+whichever replica their keys hash to. This module generalizes it:
+
+* every request belongs to an **admission class** with a deadline — the SLO
+  the caller actually cares about. Admission compares the tier's estimated
+  wait against the CLASS deadline, so a 15 s ``ensemble`` request is
+  admitted at queue depths where a 2 s ``fast`` request is shed (the
+  "ensemble vs fast" split is the SLO-tier hook ROADMAP item 6's
+  uncertainty serving plugs into);
+* shedding raises :class:`RouterBusyError` carrying a **jittered**
+  retry-after (uniform 0.5x–1.5x) plus the router's own queue depth and,
+  when a replica's 429 was the proximate cause, that replica's hint — the
+  caller sees the honest fleet picture and retries desynchronized.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional
+
+
+@dataclass(frozen=True)
+class AdmissionClass:
+    """One SLO tier: requests of this class must resolve within
+    ``deadline_s`` of admission or be shed/failed explicitly. ``priority``
+    is reserved as the tie-breaker for ROADMAP item 6's ensemble tier
+    (admission today differentiates classes purely by deadline)."""
+
+    name: str
+    deadline_s: float
+    priority: int = 0
+
+
+#: Default tiers: ``fast`` is the single-model low-latency path; ``ensemble``
+#: is the accurate/uncertainty tier (longer deadline — it tolerates deeper
+#: queues and, once item 6 lands, N-model fan-out).
+DEFAULT_CLASSES = (
+    AdmissionClass("fast", deadline_s=2.0, priority=0),
+    AdmissionClass("ensemble", deadline_s=15.0, priority=1),
+)
+
+
+def build_classes(
+    spec: "Optional[Mapping[str, Any]]" = None,
+) -> Dict[str, AdmissionClass]:
+    """Admission-class table from a config mapping
+    ``{name: {"deadline_s": float, "priority": int?}}`` (or
+    ``{name: float}`` shorthand). ``None`` -> :data:`DEFAULT_CLASSES`.
+    Validation mirrors the static checker (analysis/contracts.py
+    ``bad-router``): a class without a positive finite deadline is refused
+    here too — an SLO class with no SLO is meaningless."""
+    if spec is None:
+        return {c.name: c for c in DEFAULT_CLASSES}
+    out: Dict[str, AdmissionClass] = {}
+    for name, val in spec.items():
+        if isinstance(val, Mapping):
+            deadline = val.get("deadline_s")
+            priority = int(val.get("priority", 0))
+        else:
+            deadline, priority = val, 0
+        try:
+            deadline_f = float(deadline)
+        except (TypeError, ValueError):
+            deadline_f = float("nan")
+        if not math.isfinite(deadline_f) or deadline_f <= 0:
+            raise ValueError(
+                f"admission class {name!r} needs a positive finite "
+                f"deadline_s, got {deadline!r}"
+            )
+        out[str(name)] = AdmissionClass(str(name), deadline_f, priority)
+    if not out:
+        raise ValueError("admission class table must not be empty")
+    return out
+
+
+def jittered(hint_s: float, rng: random.Random) -> float:
+    """De-synchronize client retries: uniform 0.5x–1.5x around the hint.
+    Without it every client that saw the same shed retries on the same
+    tick and the hash ring lands the herd on one replica."""
+    return max(0.05, float(hint_s)) * (0.5 + rng.random())
+
+
+class RouterBusyError(RuntimeError):
+    """The tier cannot meet this request's class deadline — the fleet-wide
+    429. ``retry_after_s`` is already jittered; ``replica_retry_after_s``
+    is the raw hint from the replica whose shed triggered this (None when
+    admission itself shed); ``queue_depth`` is the router's in-flight count
+    at shed time; ``hops`` is the per-request hop log up to the shed."""
+
+    retryable = True
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float,
+        queue_depth: int = 0,
+        replica_retry_after_s: Optional[float] = None,
+        klass: str = "fast",
+        hops: "Optional[List[dict]]" = None,
+    ):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.queue_depth = int(queue_depth)
+        self.replica_retry_after_s = replica_retry_after_s
+        self.klass = klass
+        self.hops = list(hops or [])
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Every candidate replica is down/draining — explicit retryable
+    failure (HTTP 503 + Retry-After at the front end). Accepted requests
+    are NEVER silently dropped: a request that cannot be completed gets
+    this, a :class:`RouterBusyError`, or a TimeoutError — all explicit."""
+
+    retryable = True
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float = 1.0,
+        hops: "Optional[List[dict]]" = None,
+    ):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+        self.hops = list(hops or [])

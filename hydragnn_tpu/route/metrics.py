@@ -1,0 +1,153 @@
+"""Router metrics: the ``hydragnn_route_*`` Prometheus family
+(docs/OBSERVABILITY.md "Prometheus catalogue", docs/SERVING.md
+"Multi-replica tier").
+
+Same design as the engine's ``ServeMetrics``: host-side, one lock, seconds
+credited into the shared ``Timer`` registry (``route_*`` names), fixed-bound
+latency histograms per admission class. Observations arrive from every
+router caller thread (main / HTTP handlers) plus the health-loop thread —
+all fields are declared guarded and graftrace-checked.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+from ..analysis import tsan
+from ..serve.metrics import LatencyHistogram
+from ..utils.time_utils import Timer
+
+
+class RouteMetrics:
+    """All counters/histograms of one ``Router``."""
+
+    _COUNTERS = (
+        "requests_total",
+        "shed_total",
+        "retries_total",
+        "spilled_total",
+        "failed_total",
+        "hops_total",
+        "replica_down_dispatch_total",
+        "health_checks_total",
+        "drains_total",
+        "ejections_total",
+        "readmissions_total",
+        "warm_admissions_total",
+    )
+
+    def __init__(self, class_names: Sequence[str] = ("fast", "ensemble")):
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "RouteMetrics._lock"
+        )
+        self.requests_total = 0  # guarded-by: self._lock
+        self.shed_total = 0  # guarded-by: self._lock
+        self.retries_total = 0  # guarded-by: self._lock
+        self.spilled_total = 0  # guarded-by: self._lock
+        self.failed_total = 0  # guarded-by: self._lock
+        self.hops_total = 0  # guarded-by: self._lock
+        self.replica_down_dispatch_total = 0  # guarded-by: self._lock
+        self.health_checks_total = 0  # guarded-by: self._lock
+        self.drains_total = 0  # guarded-by: self._lock
+        self.ejections_total = 0  # guarded-by: self._lock
+        self.readmissions_total = 0  # guarded-by: self._lock
+        self.warm_admissions_total = 0  # guarded-by: self._lock
+        # Per admission class: request/shed counters + an e2e latency
+        # histogram (the fleet-level p50/p95/p99 the load rig reports).
+        self._per_class: Dict[str, Dict[str, int]] = {  # guarded-by: self._lock
+            str(c): {"requests": 0, "shed": 0} for c in class_names
+        }
+        self.latency: Dict[str, LatencyHistogram] = {  # guarded-by: self._lock, dirty-reads(dict is immutable after construction; the leaf histograms carry their own lock)
+            str(c): LatencyHistogram() for c in class_names
+        }
+        # Replica lifecycle states (admitted/warming/draining/ejected),
+        # maintained by the Router's health loop — the _replica_state gauge.
+        self._replica_states: Dict[str, str] = {}  # guarded-by: self._lock
+
+    # ------------------------------------------------------------- recorders
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+            tsan.shared_access("RouteMetrics.counters")
+
+    def count_class(self, klass: str, which: str, n: int = 1) -> None:
+        with self._lock:
+            entry = self._per_class.setdefault(
+                klass, {"requests": 0, "shed": 0}
+            )
+            entry[which] = entry.get(which, 0) + n
+
+    def observe(self, klass: str, seconds: float) -> None:
+        hist = self.latency.get(klass)
+        if hist is None:
+            with self._lock:
+                hist = self.latency.setdefault(klass, LatencyHistogram())
+        hist.observe(seconds)
+        Timer.credit("route_e2e", seconds)
+
+    def set_replica_state(self, name: str, state: Optional[str]) -> None:
+        """Record one replica's lifecycle state (None removes it)."""
+        with self._lock:
+            if state is None:
+                self._replica_states.pop(name, None)
+            else:
+                self._replica_states[name] = str(state)
+
+    def read_counters(self, *names: str) -> Dict[str, float]:
+        """One locked copy of the named counters (cross-thread readers must
+        not assemble their view field-by-field — same contract as
+        ServeMetrics.read_counters)."""
+        with self._lock:
+            return {n: getattr(self, n) for n in names}
+
+    # -------------------------------------------------------------- reporters
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out: Dict = {n: getattr(self, n) for n in self._COUNTERS}
+            out["per_class"] = {
+                k: dict(v) for k, v in sorted(self._per_class.items())
+            }
+            out["replica_states"] = dict(sorted(self._replica_states.items()))
+            classes = list(self.latency)
+        out["latency_ms"] = {
+            k: self.latency[k].snapshot() for k in classes
+        }
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition — the router /metrics payload."""
+        p = "hydragnn_route"
+        snap = self.snapshot()
+        lines = []
+        for name in self._COUNTERS:
+            lines.append(f"# TYPE {p}_{name} counter")
+            lines.append(f"{p}_{name} {snap[name]}")
+        lines.append(f"# TYPE {p}_class_requests_total counter")
+        for klass, c in snap["per_class"].items():
+            lines.append(
+                f'{p}_class_requests_total{{class="{klass}"}} '
+                f"{c['requests']}"
+            )
+        lines.append(f"# TYPE {p}_class_shed_total counter")
+        for klass, c in snap["per_class"].items():
+            lines.append(
+                f'{p}_class_shed_total{{class="{klass}"}} {c["shed"]}'
+            )
+        # One gauge sample per replica, state as a label (value is always 1
+        # for the current state — the standard state-set exposition).
+        lines.append(f"# TYPE {p}_replica_state gauge")
+        for name, state in snap["replica_states"].items():
+            lines.append(
+                f'{p}_replica_state{{replica="{name}",state="{state}"}} 1'
+            )
+        lines.append(f"# TYPE {p}_latency_seconds histogram")
+        with self._lock:
+            hists = dict(self.latency)
+        for klass, hist in hists.items():
+            lines.extend(
+                hist.prometheus_lines(
+                    f"{p}_latency_seconds", labels=f'class="{klass}"'
+                )
+            )
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,106 @@
+"""Weighted consistent-hash ring for the front router (docs/SERVING.md
+"Multi-replica tier").
+
+Why consistent hashing at all: the serve engines behind the router keep
+per-bucket compiled-executable caches AND micro-batch across requests, so
+steady request->replica affinity (same correlation-id prefix lands on the
+same replica) keeps each replica's working set of bucket shapes small and
+its micro-batches full. A plain round-robin would spray every bucket shape
+across every replica. The ring makes membership changes cheap too: adding
+or removing one replica moves only ~1/N of the keyspace (locked by
+tests/test_route.py's bounded-movement test), so a drain or a warm
+spin-up does not reshuffle the whole fleet's affinity.
+
+The ring is deliberately NOT thread-safe: the owning ``Router`` mutates and
+queries it exclusively under its own ``_lock`` (graftrace-checked there).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import math
+from typing import Dict, List, Optional
+
+
+def _point(label: str) -> int:
+    """Stable 64-bit ring position for a label (sha256 prefix — no Python
+    ``hash()``: ring layout must agree across processes and restarts)."""
+    return int.from_bytes(hashlib.sha256(label.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with per-member weights via virtual nodes.
+
+    ``vnodes`` virtual points per unit of weight; a weight-2 replica owns
+    ~2x the keyspace of a weight-1 replica. ``owners(key)`` returns ALL
+    members in ring-walk order from the key's position — the router's
+    primary-then-spill candidate list.
+    """
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._weights: Dict[str, float] = {}  # guarded-by: external(the owning Router mutates and queries the ring only under Router._lock)
+        self._points: List[int] = []  # guarded-by: external(the owning Router mutates and queries the ring only under Router._lock)
+        self._names: List[str] = []  # guarded-by: external(the owning Router mutates and queries the ring only under Router._lock)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._weights
+
+    def __len__(self) -> int:
+        return len(self._weights)
+
+    @property
+    def members(self) -> List[str]:
+        return sorted(self._weights)
+
+    def weight(self, name: str) -> Optional[float]:
+        return self._weights.get(name)
+
+    def add(self, name: str, weight: float = 1.0) -> None:
+        """Add (or re-weight) a member. Weight must be positive and finite —
+        the contracts checker rejects nonsense weights before a router is
+        even built (analysis/contracts.py ``bad-router``); this is the
+        runtime backstop."""
+        weight = float(weight)
+        if not math.isfinite(weight) or weight <= 0:
+            raise ValueError(
+                f"replica weight must be a positive finite number, got "
+                f"{weight!r} for {name!r}"
+            )
+        self._weights[str(name)] = weight
+        self._rebuild()
+
+    def remove(self, name: str) -> None:
+        """Remove a member (no-op when absent — drain paths call this
+        idempotently)."""
+        if self._weights.pop(name, None) is not None:
+            self._rebuild()
+
+    def _rebuild(self) -> None:
+        pts = []
+        for name, w in self._weights.items():
+            for i in range(max(1, round(self.vnodes * w))):
+                pts.append((_point(f"{name}#{i}"), name))
+        pts.sort()
+        self._points = [p for p, _ in pts]
+        self._names = [n for _, n in pts]
+
+    def owners(self, key: str, n: Optional[int] = None) -> List[str]:
+        """Distinct members in ring order starting at ``key``'s position:
+        ``owners(key)[0]`` is the primary, the rest are the bounded-load
+        spill candidates in preference order. ``n`` truncates the walk."""
+        if not self._points:
+            return []
+        want = len(self._weights) if n is None else min(n, len(self._weights))
+        i = bisect.bisect_left(self._points, _point(key)) % len(self._points)
+        out: List[str] = []
+        for j in range(len(self._points)):
+            name = self._names[(i + j) % len(self._points)]
+            if name not in out:
+                out.append(name)
+                if len(out) == want:
+                    break
+        return out

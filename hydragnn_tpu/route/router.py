@@ -1,0 +1,767 @@
+"""The front router: consistent-hash dispatch over N engine replicas with
+SLO-aware admission, replica health, and warm spin-up (docs/SERVING.md
+"Multi-replica tier"; ROADMAP item 1).
+
+One ``Router`` owns the routing table (hash ring + per-replica lifecycle
+records). Requests are admitted against their class deadline
+(admission.py), hashed to a primary replica, spilled to the next ring owner
+when the primary is over the bounded-load limit, and retried on another
+replica when one sheds (429) or dies mid-dispatch — always within the
+request's deadline, never silently: every admitted request resolves to a
+result or an explicit retryable error.
+
+Replica lifecycle (the health loop's state machine, one poll per
+``health_interval_s``)::
+
+    warming --hydrated--> admitted --degraded counters moved--> draining
+    draining --quiet for readmit_polls--> admitted
+    (admitted|draining) --eject_after failed polls--> ejected
+    ejected --healthz ok again--> warming   (re-verifies hydration)
+
+``draining``/``ejected`` replicas leave the hash ring (no NEW requests;
+in-flight ones finish) but keep being polled so recovery readmits them.
+"degraded counters moved" means the replica's sticky /healthz fault
+counters (bad batches, non-finite outputs, worker restarts) INCREASED
+since the previous poll — the sticky bit alone cannot drive draining or a
+once-degraded replica could never come back.
+
+Warm spin-up (``scale_up``): the factory builds a replica pointed at the
+shared graftcache store on a spawner thread; the new replica enters the
+table as ``warming`` and is only admitted once its /healthz reports the
+expected bucket-ladder rungs compiled — on a warm store that is hydration
+(milliseconds-scale deserialize, zero XLA compiles), locked by
+tests/test_route.py's compile-spy test.
+
+Concurrency: ``_table``/``_ring``/``_inflight_total`` are cross-thread
+state (caller threads dispatch, the health loop transitions, the spawner
+publishes) — all access is under ``_lock`` with ``# guarded-by:``
+annotations, graftrace-checked, and the dispatch path carries a tsan yield
+point (``route.dispatch.pre_send``) for the schedule-fuzz drill. No JAX
+from router threads: dispatch blocks on engine futures, the device work
+stays on each engine's own sanctioned dispatch thread.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..analysis import tsan
+from ..telemetry import graftel as telemetry
+from .admission import (
+    AdmissionClass,
+    NoReplicaAvailableError,
+    RouterBusyError,
+    build_classes,
+    jittered,
+)
+from .metrics import RouteMetrics
+from .replica import (
+    Replica,
+    ReplicaBackpressureError,
+    ReplicaDownError,
+)
+from .ring import HashRing
+
+WARMING = "warming"
+ADMITTED = "admitted"
+DRAINING = "draining"
+EJECTED = "ejected"
+
+
+class RouteResult:
+    """One routed prediction: per-graph per-head outputs plus the hop log
+    (which replicas were tried, in order, with outcomes) — the response's
+    routing provenance (docs/OBSERVABILITY.md "Serve request correlation")."""
+
+    __slots__ = ("results", "request_id", "replica", "hops", "klass")
+
+    def __init__(self, results, request_id, replica, hops, klass):
+        self.results = results
+        self.request_id = request_id
+        self.replica = replica
+        self.hops = hops
+        self.klass = klass
+
+
+class _ReplicaEntry:
+    """One replica's routing-table record. Fields are mutated by the caller
+    threads (inflight), the health loop (state machine), and the spawner
+    (replica publication) — every access goes through the owning Router's
+    ``_lock``; the per-field declarations below record that contract."""
+
+    __slots__ = (
+        "replica",
+        "weight",
+        "state",
+        "inflight",
+        "fails",
+        "healthy_polls",
+        "deg_baseline",
+        "expected_rungs",
+        "last_health",
+        "spawn_wall_s",
+    )
+
+    def __init__(
+        self,
+        replica: Optional[Replica],
+        weight: float,
+        state: str,
+        expected_rungs: Optional[int],
+    ):
+        self.replica = replica  # guarded-by: external(every access holds the owning Router._lock)
+        self.weight = float(weight)  # guarded-by: external(every access holds the owning Router._lock)
+        self.state = state  # guarded-by: external(every access holds the owning Router._lock)
+        self.inflight = 0  # guarded-by: external(every access holds the owning Router._lock)
+        self.fails = 0  # guarded-by: external(every access holds the owning Router._lock)
+        self.healthy_polls = 0  # guarded-by: external(every access holds the owning Router._lock)
+        self.deg_baseline: Optional[int] = None  # guarded-by: external(every access holds the owning Router._lock)
+        self.expected_rungs = expected_rungs  # guarded-by: external(every access holds the owning Router._lock)
+        self.last_health: Optional[dict] = None  # guarded-by: external(every access holds the owning Router._lock)
+        self.spawn_wall_s: Optional[float] = None  # guarded-by: external(every access holds the owning Router._lock)
+
+
+class Router:
+    """Consistent-hash front router over N :class:`Replica` backends.
+
+    Parameters
+    ----------
+    replicas:
+        Initial replicas (already warm — built/warmed by the caller);
+        admitted immediately. Accepts ``Replica`` objects or
+        ``(Replica, weight)`` pairs.
+    classes:
+        Admission-class spec (admission.build_classes). Default: ``fast``
+        (2 s) + ``ensemble`` (15 s, reserved for ROADMAP item 6).
+    load_factor:
+        Bounded-load consistent hashing: a replica whose in-flight count
+        exceeds ``ceil(load_factor * (total_inflight + 1) / admitted)``
+        spills to the next ring owner. Must be >= 1.
+    health_interval_s, eject_after, readmit_polls:
+        Health-loop cadence; consecutive failed polls before ejection;
+        consecutive quiet polls before a draining replica readmits.
+    expected_rungs:
+        Bucket-ladder rungs a warming replica must report compiled before
+        admission (per-replica override on ``add_replica``/``scale_up``).
+        0/None accepts the first healthy poll with >= 1 compiled bucket.
+    max_hops:
+        Dispatch attempts (primary + retries) per request, deadline
+        permitting.
+    jitter_seed:
+        Seeds the retry-after jitter stream (tests pin it; production
+        leaves it None for OS entropy).
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Any] = (),
+        *,
+        classes: Optional[dict] = None,
+        load_factor: float = 1.25,
+        vnodes: int = 64,
+        health_interval_s: float = 0.5,
+        eject_after: int = 2,
+        readmit_polls: int = 2,
+        expected_rungs: int = 0,
+        max_hops: int = 3,
+        default_timeout_s: float = 60.0,
+        metrics: Optional[RouteMetrics] = None,
+        jitter_seed: Optional[int] = None,
+        autostart_health: bool = True,
+    ):
+        if load_factor < 1.0 or not math.isfinite(load_factor):
+            raise ValueError(
+                f"load_factor must be a finite number >= 1, got {load_factor}"
+            )
+        self.classes: Dict[str, AdmissionClass] = build_classes(classes)
+        self.load_factor = float(load_factor)
+        self.health_interval_s = float(health_interval_s)
+        self.eject_after = int(eject_after)
+        self.readmit_polls = int(readmit_polls)
+        self.expected_rungs = int(expected_rungs or 0)
+        self.max_hops = int(max_hops)
+        self.default_timeout_s = float(default_timeout_s)
+        self.metrics = (
+            metrics
+            if metrics is not None
+            else RouteMetrics(class_names=list(self.classes))
+        )
+        self._lock = tsan.instrument_lock(threading.Lock(), "Router._lock")
+        # The routing table: replica name -> lifecycle record. Written by
+        # add/scale/dispatch/health threads.
+        self._table: Dict[str, _ReplicaEntry] = {}  # guarded-by: self._lock
+        # Ring membership == ADMITTED replicas only; mutated and queried
+        # exclusively under the lock (ring.py is not thread-safe itself).
+        self._ring = HashRing(vnodes)  # guarded-by: self._lock, dirty-reads(the attribute cell is bound once here; every mutation and owners() lookup runs under the lock)
+        self._inflight_total = 0  # guarded-by: self._lock
+        # Retry-jitter stream; Random() is internally locked, the seed makes
+        # shed hints reproducible in tests.
+        self._rng = random.Random(jitter_seed)
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._health_ctx: Optional[Any] = None
+        for item in replicas:
+            if isinstance(item, tuple):
+                self.add_replica(item[0], weight=item[1])
+            else:
+                self.add_replica(item)
+        if autostart_health:
+            self.start_health_loop()
+
+    # ------------------------------------------------------------- lifecycle
+    def add_replica(
+        self,
+        replica: Replica,
+        weight: float = 1.0,
+        warm: bool = False,
+        expected_rungs: Optional[int] = None,
+    ) -> None:
+        """Register a replica. ``warm=False`` (callers hand over an
+        already-warm replica) admits immediately; ``warm=True`` enters the
+        ``warming`` state and lets the health loop admit once the bucket
+        ladder is hydrated."""
+        name = replica.name
+        state = WARMING if warm else ADMITTED
+        with self._lock:
+            if name in self._table:
+                raise ValueError(f"replica {name!r} already registered")
+            ent = _ReplicaEntry(replica, weight, state, expected_rungs)
+            self._table[name] = ent
+            if state == ADMITTED:
+                self._ring.add(name, weight)
+        self.metrics.set_replica_state(name, state)
+        telemetry.event("route/replica_added", replica=name, state=state)
+
+    def scale_up(
+        self,
+        name: str,
+        factory: Callable[[], Replica],
+        weight: float = 1.0,
+        expected_rungs: Optional[int] = None,
+    ) -> threading.Thread:
+        """Warm spin-up: run ``factory`` (which should build an engine
+        pointed at the SHARED graftcache store — docs/COMPILE_CACHE.md) on
+        a spawner thread; the replica is ``warming`` until its ladder
+        reports hydrated and takes no traffic before admission. Returns the
+        spawner thread (join it in tests/drills)."""
+        with self._lock:
+            if name in self._table:
+                raise ValueError(f"replica {name!r} already registered")
+            self._table[name] = _ReplicaEntry(
+                None, weight, WARMING, expected_rungs
+            )
+        self.metrics.set_replica_state(name, WARMING)
+        telemetry.event("route/scale_up", replica=name)
+        thread = threading.Thread(
+            target=self._spawn_replica,
+            args=(name, factory),
+            name="hydragnn-route-spawn",
+            daemon=True,
+        )
+        thread.start()
+        return thread
+
+    def _spawn_replica(self, name: str, factory: Callable[[], Replica]) -> None:
+        t0 = time.perf_counter()
+        try:
+            replica = factory()
+        except Exception as e:  # noqa: BLE001 — spawn failure is a state, not a crash
+            with self._lock:
+                ent = self._table.get(name)
+                if ent is not None:
+                    ent.state = EJECTED
+            self.metrics.set_replica_state(name, EJECTED)
+            self.metrics.count("ejections_total")
+            telemetry.event(
+                "route/spawn_failed", replica=name, error=repr(e)
+            )
+            return
+        stale = None
+        with self._lock:
+            ent = self._table.get(name)
+            if ent is None:
+                stale = replica  # removed while spawning — close it below
+            else:
+                ent.replica = replica
+                ent.spawn_wall_s = time.perf_counter() - t0
+        if stale is not None:
+            stale.close()
+            return
+        telemetry.event(
+            "route/spawned",
+            replica=name,
+            wall_s=round(time.perf_counter() - t0, 4),
+        )
+
+    def remove_replica(self, name: str) -> Optional[Replica]:
+        """Drop a replica from the table entirely (the caller closes it)."""
+        with self._lock:
+            ent = self._table.pop(name, None)
+            self._ring.remove(name)
+        self.metrics.set_replica_state(name, None)
+        return ent.replica if ent is not None else None
+
+    def start_health_loop(self) -> None:
+        """Launch the health-poll thread (idempotent)."""
+        if self._health_thread is not None:
+            return
+        self._health_ctx = telemetry.new_context()
+        self._health_thread = threading.Thread(
+            target=self._health_loop,
+            name="hydragnn-route-health",
+            daemon=True,
+        )
+        self._health_thread.start()
+
+    def close(self, close_replicas: bool = False, timeout: float = 5.0) -> None:
+        """Stop the health loop (and optionally the replicas)."""
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout)
+        if close_replicas:
+            with self._lock:
+                replicas = [
+                    e.replica
+                    for e in self._table.values()
+                    if e.replica is not None
+                ]
+            for r in replicas:
+                r.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------------------------------------------------------- status
+    def states(self) -> Dict[str, Dict[str, Any]]:
+        """Locked snapshot of the replica-health map — the router /healthz
+        payload's ``replicas`` field."""
+        with self._lock:
+            return {
+                name: {
+                    "state": ent.state,
+                    "weight": ent.weight,
+                    "inflight": ent.inflight,
+                    "fails": ent.fails,
+                    "spawn_wall_s": ent.spawn_wall_s,
+                    "last_health": dict(ent.last_health)
+                    if ent.last_health
+                    else None,
+                }
+                for name, ent in sorted(self._table.items())
+            }
+
+    @property
+    def default_class(self) -> str:
+        """The admission class a caller that names none gets: ``fast``
+        when configured (the stock tier), else the tightest-deadline class
+        — so the single-engine request schema (no ``class`` field) keeps
+        working against a custom-class fleet."""
+        if "fast" in self.classes:
+            return "fast"
+        return min(self.classes.values(), key=lambda c: c.deadline_s).name
+
+    def admitted_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for e in self._table.values() if e.state == ADMITTED
+            )
+
+    def queue_depth(self) -> int:
+        """Router-level in-flight count (the fleet 'queue depth' shed
+        responses report)."""
+        with self._lock:
+            return self._inflight_total
+
+    # ------------------------------------------------------------- dispatch
+    def predict(
+        self,
+        samples: Sequence[Any],
+        klass: Optional[str] = None,
+        timeout: Optional[float] = None,
+        request_id: Optional[str] = None,
+    ) -> RouteResult:
+        """Route one prediction call. Admission against the class deadline,
+        consistent-hash primary + bounded-load spill, retry on shed/down
+        replicas while the deadline allows. ``klass=None`` takes
+        :attr:`default_class`. Raises :class:`RouterBusyError` (shed,
+        retryable, jittered hint), :class:`NoReplicaAvailableError` (no
+        serving replica, retryable), or propagates per-request errors
+        (ValueError, TimeoutError)."""
+        if klass is None:
+            klass = self.default_class
+        ac = self.classes.get(klass)
+        if ac is None:
+            raise ValueError(
+                f"unknown admission class {klass!r}; configured: "
+                f"{sorted(self.classes)}"
+            )
+        rid = request_id or telemetry.new_request_id()
+        hop_timeout = (
+            timeout if timeout is not None else self.default_timeout_s
+        )
+        t0 = time.perf_counter()
+        deadline = t0 + ac.deadline_s
+        self.metrics.count("requests_total")
+        self.metrics.count_class(klass, "requests")
+        self._admit(ac, rid)
+
+        hops: List[dict] = []
+        tried: set = set()
+        last_bp: Optional[ReplicaBackpressureError] = None
+        for _hop in range(self.max_hops):
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            target = self._acquire_target(rid, tried)
+            if target is None:
+                break
+            name, replica, spilled = target
+            if spilled:
+                self.metrics.count("spilled_total")
+            if hops:
+                # A retry is a SUBSEQUENT attempt actually starting — the
+                # final failed attempt of a shed request is not a retry.
+                self.metrics.count("retries_total")
+            tsan.yield_point("route.dispatch.pre_send")
+            t_hop = time.perf_counter()
+            try:
+                with telemetry.span(
+                    "route/dispatch",
+                    request_id=rid,
+                    replica=name,
+                    klass=klass,
+                    hop=len(hops),
+                ):
+                    results = replica.predict(
+                        samples,
+                        timeout=min(remaining, hop_timeout),
+                        request_id=rid,
+                    )
+            except ReplicaBackpressureError as e:
+                self._release(name, ok=True)
+                hops.append(self._hop(name, "backpressure", t_hop, spilled))
+                self.metrics.count("hops_total")
+                tried.add(name)
+                last_bp = e
+                telemetry.event(
+                    "route/replica_shed", request_id=rid, replica=name
+                )
+                continue
+            except ReplicaDownError as e:
+                # Fast feedback: drain NOW (the health loop confirms the
+                # ejection); the request retries on the next ring owner.
+                self._release(name, ok=False)
+                hops.append(self._hop(name, "down", t_hop, spilled))
+                self.metrics.count("hops_total")
+                tried.add(name)
+                self.metrics.count("replica_down_dispatch_total")
+                telemetry.event(
+                    "route/replica_down",
+                    request_id=rid,
+                    replica=name,
+                    error=repr(e),
+                )
+                continue
+            except BaseException:
+                # Per-request errors (validation, timeout): not the
+                # replica's fault — release without marking it suspect.
+                self._release(name, ok=True)
+                hops.append(self._hop(name, "error", t_hop, spilled))
+                self.metrics.count("hops_total")
+                raise
+            self._release(name, ok=True)
+            hops.append(self._hop(name, "ok", t_hop, spilled))
+            self.metrics.count("hops_total")
+            e2e = time.perf_counter() - t0
+            self.metrics.observe(klass, e2e)
+            telemetry.event(
+                "route/response",
+                request_id=rid,
+                replica=name,
+                hops=len(hops),
+                e2e_s=round(e2e, 6),
+            )
+            return RouteResult(results, rid, name, hops, klass)
+
+        # Candidates exhausted (or deadline passed) without a result.
+        depth = self.queue_depth()
+        if last_bp is not None:
+            self.metrics.count("shed_total")
+            self.metrics.count_class(klass, "shed")
+            hint = jittered(last_bp.retry_after_s, self._rng)
+            telemetry.event(
+                "route/shed", request_id=rid, klass=klass, reason="replicas_busy"
+            )
+            raise RouterBusyError(
+                f"all candidate replicas shed within the {klass!r} deadline "
+                f"({ac.deadline_s:g}s); retry in ~{hint:.2f}s",
+                retry_after_s=hint,
+                queue_depth=depth,
+                replica_retry_after_s=last_bp.retry_after_s,
+                klass=klass,
+                hops=hops,
+            )
+        self.metrics.count("failed_total")
+        hint = jittered(self.health_interval_s * 2.0, self._rng)
+        telemetry.event(
+            "route/no_replica", request_id=rid, klass=klass, hops=len(hops)
+        )
+        raise NoReplicaAvailableError(
+            "no admitted replica could serve this request "
+            f"(tried {sorted(tried) or 'none'}); retry in ~{hint:.2f}s",
+            retry_after_s=hint,
+            hops=hops,
+        )
+
+    @staticmethod
+    def _hop(name: str, outcome: str, t_hop: float, spilled: bool) -> dict:
+        return {
+            "replica": name,
+            "outcome": outcome,
+            "ms": round((time.perf_counter() - t_hop) * 1000.0, 3),
+            "spilled": spilled,
+        }
+
+    def _admit(self, ac: AdmissionClass, rid: str) -> None:
+        """Deadline-based admission: estimated fleet wait (in-flight per
+        admitted replica x observed per-request seconds) vs the class
+        deadline. The generalization of the engine's single-queue 429."""
+        with self._lock:
+            admitted = sum(
+                1 for e in self._table.values() if e.state == ADMITTED
+            )
+            inflight = self._inflight_total
+        if admitted == 0:
+            self.metrics.count("failed_total")
+            hint = jittered(self.health_interval_s * 2.0, self._rng)
+            telemetry.event(
+                "route/no_replica", request_id=rid, klass=ac.name, hops=0
+            )
+            raise NoReplicaAvailableError(
+                "no replica is admitted (all warming/draining/ejected); "
+                f"retry in ~{hint:.2f}s",
+                retry_after_s=hint,
+            )
+        hist = self.metrics.latency.get(ac.name)
+        mean = hist.mean() if hist is not None else None
+        per_req = mean if mean is not None else 0.05
+        est_wait = (inflight / admitted) * per_req
+        if est_wait > ac.deadline_s:
+            self.metrics.count("shed_total")
+            self.metrics.count_class(ac.name, "shed")
+            hint = jittered(est_wait, self._rng)
+            telemetry.event(
+                "route/shed", request_id=rid, klass=ac.name, reason="admission"
+            )
+            raise RouterBusyError(
+                f"estimated fleet wait {est_wait:.2f}s exceeds the "
+                f"{ac.name!r} deadline {ac.deadline_s:g}s; retry in "
+                f"~{hint:.2f}s",
+                retry_after_s=hint,
+                queue_depth=inflight,
+                klass=ac.name,
+            )
+
+    def _acquire_target(
+        self, rid: str, tried: set
+    ) -> Optional[Tuple[str, Replica, bool]]:
+        """Pick the next candidate under the lock: ring owners in walk
+        order, skipping tried/non-admitted replicas, spilling past owners
+        over the bounded-load limit; increments the in-flight counters."""
+        with self._lock:
+            admitted = sum(
+                1 for e in self._table.values() if e.state == ADMITTED
+            )
+            if admitted == 0:
+                return None
+            cands = [
+                n
+                for n in self._ring.owners(rid)
+                if n not in tried
+                and self._table[n].state == ADMITTED
+            ]
+            if not cands:
+                return None
+            limit = math.ceil(
+                self.load_factor * (self._inflight_total + 1) / admitted
+            )
+            chosen = None
+            least, least_load = cands[0], None
+            for n in cands:
+                load = self._table[n].inflight
+                if load < limit:
+                    chosen = n
+                    break
+                if least_load is None or load < least_load:
+                    least, least_load = n, load
+            if chosen is None:
+                # Every candidate is over the bounded-load limit: take the
+                # least-loaded one rather than shedding a routable request.
+                chosen = least
+            spilled = chosen != cands[0]
+            ent = self._table[chosen]
+            ent.inflight += 1
+            self._inflight_total += 1
+            replica = ent.replica
+        assert replica is not None  # ADMITTED implies a published replica
+        return chosen, replica, spilled
+
+    def _release(self, name: str, ok: bool) -> None:
+        """Return an in-flight slot; a dispatch-observed failure drains the
+        replica immediately (health loop confirms/ejects)."""
+        drained = False
+        with self._lock:
+            ent = self._table.get(name)
+            if ent is not None:
+                ent.inflight = max(0, ent.inflight - 1)
+                if not ok:
+                    ent.fails += 1
+                    if ent.state == ADMITTED:
+                        ent.state = DRAINING
+                        ent.healthy_polls = 0
+                        self._ring.remove(name)
+                        drained = True
+            self._inflight_total = max(0, self._inflight_total - 1)
+        if drained:
+            self.metrics.set_replica_state(name, DRAINING)
+            self.metrics.count("drains_total")
+            telemetry.event(
+                "route/replica_drain", replica=name, reason="dispatch_failure"
+            )
+
+    # ----------------------------------------------------------- health loop
+    def _health_loop(self) -> None:
+        telemetry.attach(self._health_ctx)
+        while not self._stop.is_set():
+            self.poll_health()
+            self._stop.wait(self.health_interval_s)
+
+    def poll_health(self) -> None:
+        """One poll round over every registered replica (the health loop's
+        body; callable directly in tests for deterministic stepping)."""
+        with self._lock:
+            targets = [
+                (name, ent.replica)
+                for name, ent in self._table.items()
+                if ent.replica is not None
+            ]
+        for name, replica in targets:
+            try:
+                h: Optional[dict] = replica.health()
+                ok = bool(h.get("ok")) if isinstance(h, dict) else False
+            except Exception:  # noqa: BLE001 — any health failure == down
+                h, ok = None, False
+            self._apply_health(name, h, ok)
+        if targets:
+            self.metrics.count("health_checks_total", len(targets))
+
+    def _apply_health(self, name: str, h: Optional[dict], ok: bool) -> None:
+        """Apply one poll result to the state machine (transitions under
+        the lock; metric/telemetry emission after release)."""
+        events: List[Tuple[str, dict]] = []
+        new_state: Optional[str] = None
+        with self._lock:
+            ent = self._table.get(name)
+            if ent is None:
+                return
+            ent.last_health = h
+            if not ok:
+                ent.fails += 1
+                # WARMING ejects too: a scale-up target whose health
+                # endpoint keeps failing must not be polled forever while
+                # permanently holding its name out of the gauge as
+                # "warming" (re-registering it needs remove_replica).
+                if (
+                    ent.state in (ADMITTED, DRAINING, WARMING)
+                    and ent.fails >= self.eject_after
+                ):
+                    ent.state = EJECTED
+                    self._ring.remove(name)
+                    new_state = EJECTED
+                    events.append(("route/replica_eject", {"replica": name}))
+            else:
+                ent.fails = 0
+                deg = sum(
+                    int(h.get(k, 0) or 0)
+                    for k in ("bad_batches", "nonfinite_outputs", "restarts")
+                )
+                if ent.state == EJECTED:
+                    # Came back: re-verify hydration before readmission.
+                    ent.state = WARMING
+                    ent.deg_baseline = deg
+                    new_state = WARMING
+                elif ent.state == WARMING:
+                    needed = (
+                        ent.expected_rungs
+                        if ent.expected_rungs is not None
+                        else self.expected_rungs
+                    ) or 1
+                    if int(h.get("compiled_buckets", 0)) >= needed:
+                        ent.state = ADMITTED
+                        ent.deg_baseline = deg
+                        self._ring.add(name, ent.weight)
+                        new_state = ADMITTED
+                        events.append(
+                            (
+                                "route/replica_admit",
+                                {
+                                    "replica": name,
+                                    "compiled_buckets": int(
+                                        h.get("compiled_buckets", 0)
+                                    ),
+                                    "hydrated_buckets": int(
+                                        h.get("hydrated_buckets", 0) or 0
+                                    ),
+                                    "spawn_wall_s": ent.spawn_wall_s,
+                                },
+                            )
+                        )
+                elif ent.state == ADMITTED:
+                    if ent.deg_baseline is None:
+                        ent.deg_baseline = deg
+                    elif deg > ent.deg_baseline:
+                        ent.state = DRAINING
+                        ent.healthy_polls = 0
+                        ent.deg_baseline = deg
+                        self._ring.remove(name)
+                        new_state = DRAINING
+                        events.append(
+                            (
+                                "route/replica_drain",
+                                {"replica": name, "reason": "degraded"},
+                            )
+                        )
+                    else:
+                        ent.deg_baseline = deg
+                elif ent.state == DRAINING:
+                    if ent.deg_baseline is not None and deg > ent.deg_baseline:
+                        ent.healthy_polls = 0
+                    else:
+                        ent.healthy_polls += 1
+                    ent.deg_baseline = deg
+                    if ent.healthy_polls >= self.readmit_polls:
+                        ent.state = ADMITTED
+                        self._ring.add(name, ent.weight)
+                        new_state = ADMITTED
+                        events.append(
+                            ("route/replica_readmit", {"replica": name})
+                        )
+        if new_state is not None:
+            self.metrics.set_replica_state(name, new_state)
+        for ev_name, attrs in events:
+            if ev_name == "route/replica_eject":
+                self.metrics.count("ejections_total")
+            elif ev_name == "route/replica_drain":
+                self.metrics.count("drains_total")
+            elif ev_name == "route/replica_readmit":
+                self.metrics.count("readmissions_total")
+            elif ev_name == "route/replica_admit":
+                self.metrics.count("warm_admissions_total")
+            telemetry.event(ev_name, **attrs)

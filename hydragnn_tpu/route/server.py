@@ -1,0 +1,212 @@
+"""Stdlib HTTP front end for the router (docs/SERVING.md "Multi-replica
+tier").
+
+Same shape as serve/server.py (``http.server`` is all the container has),
+but the handler threads never touch an engine directly — they call
+``Router.predict`` and block on the chosen replica. Endpoints:
+
+  POST /predict  — same request schema as the single-engine server plus an
+                   optional ``"class"`` field (admission class; default
+                   "fast"). Responses carry the per-request hop log.
+                   429 (RouterBusyError) includes the jittered Retry-After,
+                   the router queue depth, and the shedding replica's own
+                   hint; 503 (NoReplicaAvailableError) is explicit and
+                   retryable.
+  GET  /healthz  — fleet view: per-replica lifecycle states + last health.
+  GET  /metrics  — hydragnn_route_* + the process-wide graftel registry.
+
+Correlation ids: ``X-HydraGNN-Request-Id`` is honored/generated exactly
+like the engine server (same safe-charset rule) and handed to the router,
+which forwards it on every replica hop — one id, end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..serve.server import REQUEST_ID_HEADER, RequestPlumbing
+from ..telemetry import render_prometheus
+from .admission import NoReplicaAvailableError, RouterBusyError
+from .router import Router
+
+
+class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
+    # Request-id hygiene + response emission are the shared RequestPlumbing
+    # (serve/server.py) — ONE implementation of the PR-9 echo contract for
+    # both front ends. BaseHTTPRequestHandler stays an explicit base so
+    # graftrace's handler-thread-root discovery sees this class.
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def router(self) -> Router:
+        return self.server.router  # type: ignore[attr-defined]
+
+    # ---------------------------------------------------------------- routes
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        self._begin_request()
+        if self.path == "/healthz":
+            router = self.router
+            states = router.states()
+            admitted = sum(
+                1 for s in states.values() if s["state"] == "admitted"
+            )
+            self._send_json(
+                200 if admitted else 503,
+                {
+                    "ok": admitted > 0,
+                    "admitted": admitted,
+                    "replicas": states,
+                    "queue_depth": router.queue_depth(),
+                    "classes": {
+                        name: {"deadline_s": c.deadline_s, "priority": c.priority}
+                        for name, c in sorted(router.classes.items())
+                    },
+                },
+            )
+        elif self.path == "/metrics":
+            self._send_text(
+                200,
+                self.router.metrics.render_prometheus() + render_prometheus(),
+                "text/plain; version=0.0.4",
+            )
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        rid = self._begin_request()
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length) if length else b""
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+            return
+        from ..serve.server import parse_graph
+
+        try:
+            doc = json.loads(body or b"{}")
+            graphs_doc = doc.get("graphs")
+            if not isinstance(graphs_doc, list) or not graphs_doc:
+                raise ValueError('body must be {"graphs": [<graph>, ...]}')
+            samples = [parse_graph(g) for g in graphs_doc]
+            # No "class" field -> the router's default class, so the
+            # single-engine request schema works against custom-class fleets.
+            klass = doc.get("class")
+            if klass is None:
+                klass = self.router.default_class
+            if not isinstance(klass, str):
+                raise ValueError('"class" must be an admission-class name')
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": str(e), "request_id": rid})
+            return
+
+        router = self.router
+        try:
+            res = router.predict(
+                samples,
+                klass=klass,
+                timeout=getattr(self.server, "request_timeout_s", 60.0),
+                request_id=rid,
+            )
+        except RouterBusyError as e:
+            self._send_json(
+                429,
+                {
+                    "error": str(e),
+                    "retry_after_s": e.retry_after_s,
+                    "replica_retry_after_s": e.replica_retry_after_s,
+                    "queue_depth": e.queue_depth,
+                    "class": e.klass,
+                    "hops": e.hops,
+                    "request_id": rid,
+                },
+                headers={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+            return
+        except NoReplicaAvailableError as e:
+            self._send_json(
+                503,
+                {
+                    "error": str(e),
+                    "retryable": True,
+                    "retry_after_s": e.retry_after_s,
+                    "hops": e.hops,
+                    "request_id": rid,
+                },
+                headers={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+            return
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": str(e), "request_id": rid})
+            return
+        except TimeoutError as e:
+            self._send_json(504, {"error": str(e), "request_id": rid})
+            return
+        except RuntimeError as e:
+            self._send_json(503, {"error": str(e), "request_id": rid})
+            return
+
+        self._send_json(
+            200,
+            {
+                "request_id": res.request_id,
+                "replica": res.replica,
+                "class": res.klass,
+                "hops": res.hops,
+                "predictions": [
+                    [np.asarray(h).tolist() for h in per_graph]
+                    for per_graph in res.results
+                ],
+            },
+        )
+
+
+class RouterServer:
+    """ThreadingHTTPServer wrapper owning one router (mirrors
+    serve/server.py's InferenceServer lifecycle)."""
+
+    def __init__(
+        self,
+        router: Router,
+        host: str = "127.0.0.1",
+        port: int = 8100,
+        request_timeout_s: float = 60.0,
+        verbose: bool = False,
+    ):
+        self.router = router
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.router = router  # type: ignore[attr-defined]
+        self._httpd.verbose = verbose  # type: ignore[attr-defined]
+        self._httpd.request_timeout_s = request_timeout_s  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def start_background(self) -> "RouterServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="hydragnn-route-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self, close_router: bool = True) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        if close_router:
+            self.router.close()

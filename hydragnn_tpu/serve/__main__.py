@@ -2,6 +2,9 @@
 
 Loads a checkpoint (native or reference-torch), optionally warms the bucket
 ladder, and serves /predict, /healthz, /metrics until interrupted.
+
+``python -m hydragnn_tpu.serve router ...`` starts the multi-replica front
+router instead (hydragnn_tpu/route/, docs/SERVING.md "Multi-replica tier").
 """
 
 from __future__ import annotations
@@ -121,11 +124,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable the non-finite output guard (NaN outputs then return "
         "as 200s instead of failing the request)",
     )
+    ap.add_argument(
+        "--replica-id",
+        default=None,
+        metavar="NAME",
+        help="label this serve process as one replica of a routed fleet: "
+        "echoed as the X-HydraGNN-Replica response header and in /healthz "
+        "so the router's hop logs and health map name it (docs/SERVING.md "
+        '"Multi-replica tier")',
+    )
     ap.add_argument("--verbose", action="store_true")
     return ap
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "router":
+        # The front-router subcommand (hydragnn_tpu/route/__main__.py):
+        # one CLI surface for both the single engine and the fleet.
+        from ..route.__main__ import main as router_main
+
+        return router_main(argv[1:])
     args = build_parser().parse_args(argv)
     # Static contract gate (docs/STATIC_ANALYSIS.md): a broken completed
     # config or an infeasible/unparseable bucket ladder — including the
@@ -208,7 +228,11 @@ def main(argv=None) -> int:
             flush=True,
         )
     server = InferenceServer(
-        engine, host=args.host, port=args.port, verbose=args.verbose
+        engine,
+        host=args.host,
+        port=args.port,
+        verbose=args.verbose,
+        replica_id=args.replica_id,
     )
     print(
         f"hydragnn_tpu.serve listening on http://{server.host}:{server.port} "

@@ -60,6 +60,13 @@ class LatencyHistogram:
             self.count += 1
             self.sum += seconds
 
+    def mean(self) -> Optional[float]:
+        """Locked mean seconds per observation (None when empty) — the
+        per-request service estimate cross-thread readers (the router's
+        admission check) must use instead of a torn sum/count pair."""
+        with self._lock:
+            return self.sum / self.count if self.count else None
+
     def quantile(self, q: float) -> Optional[float]:
         """Interpolated q-quantile in seconds (None when empty)."""
         with self._lock:

@@ -29,6 +29,10 @@ from ..telemetry import render_prometheus
 from .engine import BackpressureError, EngineFailedError, InferenceEngine
 
 REQUEST_ID_HEADER = "X-HydraGNN-Request-Id"
+# Replica-mode plumbing (docs/SERVING.md "Multi-replica tier"): a serve
+# process running as one replica of a routed fleet labels every response so
+# the router's hop logs and clients can attribute answers to replicas.
+REPLICA_ID_HEADER = "X-HydraGNN-Replica"
 
 
 def parse_graph(doc: dict) -> GraphSample:
@@ -61,17 +65,18 @@ def parse_graph(doc: dict) -> GraphSample:
     return GraphSample(x=x, pos=pos, edge_index=edge_index, edge_attr=edge_attr)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # Engine injected by InferenceServer via the server object.
-    protocol_version = "HTTP/1.1"
-
-    @property
-    def engine(self) -> InferenceEngine:
-        return self.server.engine  # type: ignore[attr-defined]
+class RequestPlumbing:
+    """Shared HTTP plumbing for the engine and router front ends
+    (route/server.py): request-id hygiene and JSON/text response emission.
+    A mixin, NOT a BaseHTTPRequestHandler subclass — each concrete handler
+    keeps ``BaseHTTPRequestHandler`` as an explicit base so graftrace's
+    handler-thread-root discovery still sees it. One implementation of the
+    PR-9 contract: the correlation id is echoed on EVERY response path, and
+    a malformed caller header is REPLACED, never echoed."""
 
     def log_message(self, fmt, *args):  # quiet by default
-        if getattr(self.server, "verbose", False):
-            super().log_message(fmt, *args)
+        if getattr(self.server, "verbose", False):  # type: ignore[attr-defined]
+            super().log_message(fmt, *args)  # type: ignore[misc]
 
     def _request_id(self) -> str:
         """This request's correlation id — echoed on EVERY response path
@@ -93,7 +98,7 @@ class _Handler(BaseHTTPRequestHandler):
         """Per-request id (re)set — handler instances persist across
         keep-alive requests, so the id must NOT be cached beyond one
         request; honors a well-formed caller header, generates otherwise."""
-        raw = self.headers.get(REQUEST_ID_HEADER) or ""
+        raw = self.headers.get(REQUEST_ID_HEADER) or ""  # type: ignore[attr-defined]
         ok = (
             0 < len(raw) <= self._RID_MAX_LEN
             and all(c in self._RID_SAFE for c in raw)
@@ -107,6 +112,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.send_header(REQUEST_ID_HEADER, self._request_id())
+        replica_id = getattr(self.server, "replica_id", None)
+        if replica_id:
+            self.send_header(REPLICA_ID_HEADER, replica_id)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -118,8 +126,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.send_header(REQUEST_ID_HEADER, self._request_id())
+        replica_id = getattr(self.server, "replica_id", None)
+        if replica_id:
+            self.send_header(REPLICA_ID_HEADER, replica_id)
         self.end_headers()
         self.wfile.write(body)
+
+
+class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
+    # Engine injected by InferenceServer via the server object.
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def engine(self) -> InferenceEngine:
+        return self.server.engine  # type: ignore[attr-defined]
 
     # ---------------------------------------------------------------- routes
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
@@ -130,12 +150,20 @@ class _Handler(BaseHTTPRequestHandler):
             # degraded-but-serving (200, degraded: true — bad batches,
             # non-finite outputs, or a worker restart happened), down (503).
             fault_counters = engine.metrics.read_counters(
-                "bad_batches_total", "nonfinite_total", "engine_restarts_total"
+                "bad_batches_total",
+                "nonfinite_total",
+                "engine_restarts_total",
+                # Warmup provenance for the router's warm-spin-up gate
+                # (docs/COMPILE_CACHE.md): how many buckets came from the
+                # persistent store vs fresh compiles.
+                "exec_cache_hydrated_total",
+                "cache_misses_total",
             )
             self._send_json(
                 200 if engine.running else 503,
                 {
                     "ok": engine.running,
+                    "replica": getattr(self.server, "replica_id", None),
                     "degraded": engine.degraded,
                     # Recent degraded transitions with the correlation ids
                     # that tripped them (docs/OBSERVABILITY.md).
@@ -150,6 +178,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "bad_batches": fault_counters["bad_batches_total"],
                     "nonfinite_outputs": fault_counters["nonfinite_total"],
                     "restarts": fault_counters["engine_restarts_total"],
+                    "hydrated_buckets": fault_counters[
+                        "exec_cache_hydrated_total"
+                    ],
+                    "compiled_fresh_buckets": fault_counters[
+                        "cache_misses_total"
+                    ],
                 },
             )
         elif self.path == "/metrics":
@@ -249,12 +283,14 @@ class InferenceServer:
         port: int = 8000,
         request_timeout_s: float = 60.0,
         verbose: bool = False,
+        replica_id: Optional[str] = None,
     ):
         self.engine = engine
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.engine = engine  # type: ignore[attr-defined]
         self._httpd.verbose = verbose  # type: ignore[attr-defined]
         self._httpd.request_timeout_s = request_timeout_s  # type: ignore[attr-defined]
+        self._httpd.replica_id = replica_id  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -118,6 +118,47 @@ def pytest_last_known_serving_none_when_no_measurements(tmp_path):
     assert _last_known_serving(str(tmp_path)) is None
 
 
+def pytest_last_known_router_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_router
+
+    real = {
+        "replicas": 2,
+        "open_loop": [
+            {"fleet_p99_ms": 12.0, "offered_graphs_per_sec": 25.0},
+            {"fleet_p99_ms": 40.1, "offered_graphs_per_sec": 300.0},
+        ],
+        "kill_replica_drill": {"zero_lost": True},
+        "scaleup_drill": {"warm_spinup": {"warmup_xla_compiles": 0}},
+        "platform": "cpu",
+        "device_kind": "cpu",
+    }
+    (tmp_path / "ROUTER_r12.json").write_text(json.dumps(real))
+    # A failed --router round carries no open-loop sweep — never "last known".
+    (tmp_path / "ROUTER_r13.json").write_text(
+        json.dumps({"error": "TimeoutError"})
+    )
+    now = time.time()
+    os.utime(tmp_path / "ROUTER_r12.json", (now - 50, now - 50))
+    os.utime(tmp_path / "ROUTER_r13.json", (now - 10, now - 10))
+
+    blk = _last_known_router(str(tmp_path))
+    assert blk is not None
+    assert blk["fleet_p99_ms_at_top_load"] == 40.1
+    assert blk["offered_graphs_per_sec_top"] == 300.0
+    assert blk["kill_drill_zero_lost"] is True
+    assert blk["scaleup_warmup_xla_compiles"] == 0
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "ROUTER_r12.json"
+
+
+def pytest_last_known_router_none_when_no_measurements(tmp_path):
+    from bench import _last_known_router
+
+    (tmp_path / "ROUTER_bad.json").write_text("{not json")
+    (tmp_path / "ROUTER_r09.json").write_text(json.dumps({"error": "boom"}))
+    assert _last_known_router(str(tmp_path)) is None
+
+
 def pytest_last_known_kernels_picks_latest_real_round(tmp_path):
     from bench import _last_known_kernels
 

@@ -241,3 +241,68 @@ def pytest_run_training_rejects_broken_config():
 def pytest_serving_mode_requires_completed_config():
     with pytest.raises(ConfigContractError, match="COMPLETED"):
         check_config(_base(), mode="serving", deep=False)
+
+
+# ------------------------------------------------------------ router findings
+def pytest_router_config_findings():
+    """graftroute config contract (ISSUE 12): replica-count / hash-ring
+    weight / admission-class / fleet-ladder-memory nonsense is a
+    ``bad-router`` finding through the same gate_config path as every other
+    entry point (the route CLI passes its fleet shape here)."""
+
+    def codes(router, ladder=None):
+        try:
+            check_config(
+                _base(),
+                mode="serving",
+                deep=False,
+                router=router,
+                bucket_ladder=ladder,
+            )
+        except ConfigContractError as e:
+            return [c for c, _ in e.errors]
+        return []
+
+    # Replica-count nonsense.
+    assert "bad-router" in codes({"replicas": 0})
+    assert "bad-router" in codes({"replicas": []})
+    assert "bad-router" in codes({"replicas": "two"})
+    # Hash-ring weight nonsense (negative, zero, non-finite, non-numeric).
+    for weight in (-1, 0, float("nan"), "heavy"):
+        assert "bad-router" in codes(
+            {"replicas": [{"name": "a", "weight": weight}]}
+        ), weight
+    # Admission classes without a (positive finite) deadline.
+    assert "bad-router" in codes({"replicas": 2, "classes": {"fast": {}}})
+    assert "bad-router" in codes(
+        {"replicas": 2, "classes": {"fast": {"deadline_s": -1.0}}}
+    )
+    assert "bad-router" in codes(
+        {"replicas": 2, "classes": {"ensemble": float("inf")}}
+    )
+    assert "bad-router" in codes({"replicas": 2, "classes": {}})
+    # Bounded-load / vnode / fleet-budget nonsense (never a checker crash).
+    assert "bad-router" in codes({"replicas": 2, "load_factor": 0.5})
+    assert "bad-router" in codes({"replicas": 2, "vnodes": 0})
+    assert "bad-router" in codes(
+        {"replicas": 2, "max_fleet_buckets": "lots"},
+        ladder=[(64, 256), (128, 512)],
+    )
+    # Replica count vs ladder memory: every replica holds the WHOLE ladder
+    # resident — 64 replicas x 4 rungs blows the default fleet budget.
+    ladder4 = [(64, 256), (128, 512), (256, 1024), (512, 2048)]
+    assert "bad-router" in codes({"replicas": 64}, ladder=ladder4)
+    assert "bad-router" not in codes({"replicas": 4}, ladder=ladder4)
+    # A sane fleet config contributes no router findings.
+    assert "bad-router" not in codes(
+        {
+            "replicas": [{"name": "a", "weight": 1.0}, {"name": "b"}],
+            "classes": {
+                "fast": {"deadline_s": 2.0},
+                "ensemble": {"deadline_s": 15.0},
+            },
+            "load_factor": 1.25,
+            "vnodes": 64,
+        },
+        ladder=[(64, 256)],
+    )
