@@ -520,6 +520,30 @@ def _last_known_router(search_dir: "str | None" = None) -> "dict | None":
     return _latest_artifact_block("ROUTER_*.json", extract, search_dir)
 
 
+def _last_known_swap(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent completed lifecycle rig from any committed SWAP_*
+    artifact — the graftswap analog of ``_last_known_hardware``. A failed
+    ``--swap`` round embeds this block with ``provenance: "stale"`` so an
+    rc=1 round still carries the last-known-good swap drill record."""
+
+    def extract(doc):
+        sul = doc.get("swap_under_load") or {}
+        if not doc.get("drills_total") or not sul:
+            return None
+        return {
+            "drills_passed": doc.get("drills_passed"),
+            "drills_total": doc.get("drills_total"),
+            "p99_swap_over_steady": sul.get("p99_swap_over_steady"),
+            "recompiles_after_swap": sul.get("recompiles_after_swap"),
+            "zero_version_torn": sul.get("zero_version_torn"),
+            "swap_wall_s": sul.get("swap_wall_s"),
+            "platform": doc.get("platform"),
+            "device_kind": doc.get("device_kind"),
+        }
+
+    return _latest_artifact_block("SWAP_*.json", extract, search_dir)
+
+
 def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
     """Most recent completed drill matrix from any committed FAULTS_*
     artifact — the fault-drill analog of ``_last_known_hardware``. A failed
@@ -1597,6 +1621,60 @@ def router_main() -> int:
     return 0
 
 
+def swap_main() -> int:
+    """``python bench.py --swap``: run the live-lifecycle rig
+    (benchmarks/serve_load.py run_swap_benchmark — swap-under-load +
+    rollback, corrupt-candidate, shadow-gate-rejects, kill-during-swap
+    drills) and print its block as the round's SWAP JSON line. Exit 1 when
+    any drill fails OR the swap-window p99 exceeds 1.5x steady (the ISSUE 13
+    acceptance gate); failure embeds the last known swap measurement
+    (stale-labeled), mirroring the other bench arms."""
+    result = {
+        "metric": "swap_under_load_p99_ratio",
+        "value": 0.0,
+        "unit": "x_steady_fleet_p99",
+    }
+    try:
+        import jax
+
+        _with_retries(_probe_device)
+        result["backend"] = jax.default_backend()
+        result["device_kind"] = jax.devices()[0].device_kind
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.serve_load import run_swap_benchmark
+
+        block = _with_retries(run_swap_benchmark)
+        sul = block["swap_under_load"]
+        result["value"] = sul.get("p99_swap_over_steady") or 0.0
+        result["drills_passed"] = block["drills_passed"]
+        result["drills_total"] = block["drills_total"]
+        result["recompiles_after_swap"] = sul.get("recompiles_after_swap")
+        result["zero_version_torn"] = sul.get("zero_version_torn")
+        result["swap"] = block
+        result["retries"] = _RETRIES_USED
+        ok = (
+            block["drills_passed"] == block["drills_total"]
+            and result["value"] > 0
+            and result["value"] <= 1.5
+        )
+        print(json.dumps(result))
+        return 0 if ok else 1
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        result["retries"] = _RETRIES_USED
+        try:
+            stale = _last_known_swap()
+            if stale is not None:
+                result["last_known_swap"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+
+
 def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
     'remote_compile: Connection refused') or probe timeouts — retryable;
@@ -1842,6 +1920,8 @@ if __name__ == "__main__":
         sys.exit(serve_main())
     if "--router" in sys.argv:
         sys.exit(router_main())
+    if "--swap" in sys.argv:
+        sys.exit(swap_main())
     if "--faults" in sys.argv:
         sys.exit(faults_main())
     if "--packing" in sys.argv:

@@ -77,6 +77,7 @@ def build_serving_engine(
     packing: bool = False,
     compile_cache: "str | None" = None,
     timing: "dict | None" = None,
+    model_version: str = "v0",
 ):
     """Small flagship-family engine + a request-graph pool. Default ladder is
     the pool's worst-case single bucket (the historical / unpacked arm);
@@ -111,6 +112,7 @@ def build_serving_engine(
         warmup=False,
         packing=packing,
         compile_cache=compile_cache,
+        model_version=model_version,
     )
     from hydragnn_tpu.analysis.sentinel import compile_count
 
@@ -470,15 +472,24 @@ def router_open_loop(
     n = max(1, int(duration_s * offered_rps))
     outcomes: list = [None] * n
     latencies: list = [None] * n
+    # Per-request routing/version provenance (graftswap): which replica
+    # answered and with which model version — the swap-under-load drill's
+    # zero-version-torn / monotonic-per-replica accounting reads these.
+    replicas_used: list = [None] * n
+    versions: list = [None] * n
+    t_done: list = [None] * n
 
     def one(i: int) -> None:
         t0 = time.perf_counter()
         try:
-            router.predict(
+            res = router.predict(
                 [graphs[i % len(graphs)]], klass=klass, request_id=f"rig-{i}"
             )
             outcomes[i] = "ok"
-            latencies[i] = time.perf_counter() - t0
+            t_done[i] = time.perf_counter()
+            latencies[i] = t_done[i] - t0
+            replicas_used[i] = res.replica
+            versions[i] = res.model_version
         except RouterBusyError:
             outcomes[i] = "busy"
         except NoReplicaAvailableError:
@@ -518,6 +529,25 @@ def router_open_loop(
     for o in outcomes:
         key = o if o is not None else "lost"
         counts[key] = counts.get(key, 0) + 1
+    # Version sequences in COMPLETION order per replica — the monotonicity
+    # the swap drill gates on. Each engine's single dispatch thread resolves
+    # its requests serially, and the per-thread completion stamp lands
+    # within microseconds of resolution, while distinct-version responses
+    # are whole batches (>= the flush cadence) apart — so completion-time
+    # order faithfully reconstructs the replica's resolve order. (Request
+    # INDEX order would not: thread-start jitter can reorder submissions.)
+    by_replica: dict = {}
+    order = sorted(
+        (i for i in range(n) if outcomes[i] == "ok"),
+        key=lambda i: t_done[i],
+    )
+    for i in order:
+        if replicas_used[i] is not None:
+            by_replica.setdefault(replicas_used[i], []).append(versions[i])
+    version_counts: dict = {}
+    for v in versions:
+        if v is not None:
+            version_counts[v] = version_counts.get(v, 0) + 1
     return {
         "mode": "router_open",
         "class": klass,
@@ -532,6 +562,8 @@ def router_open_loop(
         "fleet_p50_ms": q(0.50),
         "fleet_p95_ms": q(0.95),
         "fleet_p99_ms": q(0.99),
+        "version_counts": version_counts,
+        "versions_by_replica": by_replica,
     }
 
 
@@ -740,6 +772,500 @@ def run_router_benchmark(
     return block
 
 
+# ---------------------------------------------------------------------------
+# Zero-downtime model lifecycle rig (graftswap, ISSUE 13 / ROADMAP item 4)
+# ---------------------------------------------------------------------------
+def _host_variables(engine) -> dict:
+    """Host-numpy copy of an engine's (f32) variables — what the fixture
+    checkpoints and perturbs."""
+    import jax
+
+    params, bstats, _version = engine._current_weights()
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a), {"params": params, "batch_stats": bstats}
+    )
+
+
+def _perturb(variables: dict, scale: float, seed: int = 0) -> dict:
+    """Deterministically perturbed copy (the 'newly fine-tuned' — or, at
+    large ``scale``, 'deliberately bad' — candidate weights)."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    leaves, treedef = jax.tree_util.tree_flatten(variables["params"])
+    out = [
+        np.asarray(leaf)
+        + scale * rng.standard_normal(np.shape(leaf)).astype(np.float32)
+        for leaf in leaves
+    ]
+    return {
+        "params": jax.tree_util.tree_unflatten(treedef, out),
+        "batch_stats": variables.get("batch_stats", {}),
+    }
+
+
+def _swap_fixture(tmpdir: str, n_replicas: int = 2, **engine_kw):
+    """Checkpointed run dir + registry + version-tagged replica fleet:
+    saves the fleet's weights as epoch-0 (keep_last_k=3 retention),
+    registers them live, and builds N bit-identical engines tagged with the
+    live version. Returns (registry, engines, graphs, run_dir, vars0)."""
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.lifecycle import ModelRegistry
+
+    name = "swapbench"
+    run_dir = os.path.join(tmpdir, name)
+    probe, _graphs = build_serving_engine(**engine_kw)
+    vars0 = _host_variables(probe)
+    probe.close()
+    save_model(
+        vars0, None, name, path=tmpdir, meta={"epoch": 0}, keep_last_k=3
+    )
+    registry = ModelRegistry(run_dir, name)
+    live = registry.set_live()
+    engines, graphs = [], None
+    for _i in range(n_replicas):
+        engine, pool = build_serving_engine(
+            model_version=live.short, **engine_kw
+        )
+        engines.append(engine)
+        graphs = pool
+    return registry, engines, graphs, run_dir, vars0
+
+
+def _version_gates(level: dict, allowed: set) -> dict:
+    """The zero-version-torn / monotonic-per-replica accounting over one
+    ``router_open_loop`` level."""
+    observed = set(level["version_counts"])
+    torn = sorted(observed - allowed)
+    monotonic = True
+    for seq in level["versions_by_replica"].values():
+        tagged = [v for v in seq if v is not None]
+        # Once any newer version appears, the older one must never
+        # reappear on that replica (responses are per-replica ordered).
+        seen_order: list = []
+        for v in tagged:
+            if v not in seen_order:
+                seen_order.append(v)
+            elif v != seen_order[-1]:
+                monotonic = False
+    return {
+        "observed_versions": sorted(observed),
+        "version_torn_responses": torn,
+        "zero_version_torn": not torn,
+        "versions_monotonic_per_replica": monotonic,
+    }
+
+
+def swap_under_load_drill(duration_s: float, rps: float) -> dict:
+    """Hot swap + rollback under steady offered load: zero dropped
+    requests, zero version-torn responses (every response's model_version
+    is exactly one of {old, new}, monotonic per replica), zero recompiles
+    (compile-sentinel-asserted), fleet p99 during the swap window vs
+    steady state."""
+    import tempfile
+
+    from hydragnn_tpu.analysis.sentinel import compile_count
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.lifecycle import LifecycleManager
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, engines, graphs, _run_dir, vars0 = _swap_fixture(tmp)
+        router = Router(
+            [
+                InProcessReplica(f"replica-{i}", e)
+                for i, e in enumerate(engines)
+            ],
+            health_interval_s=0.1,
+            jitter_seed=0,
+        )
+        try:
+            manager = LifecycleManager(registry, engines, router=router)
+            live = registry.live
+            # Candidate: a small same-architecture weight delta (the
+            # 'trainer wrote a new checkpoint' shape).
+            save_model(
+                _perturb(vars0, 1e-3, seed=1),
+                None,
+                registry.name,
+                path=tmp,
+                meta={"epoch": 1},
+                keep_last_k=3,
+            )
+            cand = manager.stage_candidate()
+            steady = router_open_loop(router, graphs, rps, duration_s)
+
+            swap_report: dict = {}
+            c0 = compile_count()
+
+            def do_swap():
+                swap_report.update(manager.promote())
+
+            drill = router_open_loop(
+                router, graphs, rps, duration_s, mid_load_hook=do_swap
+            )
+            recompiles_after_swap = compile_count() - c0
+
+            # Instant rollback: previous restored in ONE swap, zero
+            # compiles, traffic back on the old version.
+            c1 = compile_count()
+            rollback_report = manager.rollback()
+            rollback_compiles = compile_count() - c1
+            post_rollback = router_open_loop(
+                router, graphs, rps, duration_s / 2
+            )
+
+            gates = _version_gates(drill, {live.short, cand.short})
+            p99_ratio = (
+                round(drill["fleet_p99_ms"] / steady["fleet_p99_ms"], 3)
+                if steady["fleet_p99_ms"] and drill["fleet_p99_ms"]
+                else None
+            )
+            ok = (
+                steady["lost"] == 0
+                and drill["lost"] == 0
+                and post_rollback["lost"] == 0
+                and gates["zero_version_torn"]
+                and gates["versions_monotonic_per_replica"]
+                and recompiles_after_swap == 0
+                and rollback_compiles == 0
+                and set(post_rollback["version_counts"]) <= {live.short}
+            )
+            return {
+                "ok": ok,
+                "old_version": live.short,
+                "new_version": cand.short,
+                "steady": steady,
+                "swap_window": drill,
+                "post_rollback": post_rollback,
+                "swap_report": swap_report,
+                "rollback_report": rollback_report,
+                "swap_wall_s": swap_report.get("swap_wall_s"),
+                "rollback_wall_s": rollback_report.get("swap_wall_s"),
+                "recompiles_after_swap": recompiles_after_swap,
+                "recompiles_after_rollback": rollback_compiles,
+                "fleet_p99_steady_ms": steady["fleet_p99_ms"],
+                "fleet_p99_swap_ms": drill["fleet_p99_ms"],
+                "p99_swap_over_steady": p99_ratio,
+                "zero_lost": steady["lost"] == 0 and drill["lost"] == 0,
+                **gates,
+            }
+        finally:
+            router.close()
+            for e in engines:
+                e.close()
+
+
+def corrupt_candidate_drill() -> dict:
+    """Seeded bit-flip (faults layer) on the staged candidate's file: the
+    verified chain consumes the corruption loudly (``ckpt_corrupt_detected``
+    counted, fallback recorded in supervisor.json), the registry refuses to
+    promote the recovered-but-different version, and the live version keeps
+    serving untouched."""
+    import tempfile
+
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.faults import FaultCounters
+    from hydragnn_tpu.faults.plan import FaultPlan
+    from hydragnn_tpu.lifecycle import (
+        CandidateVerificationError,
+        LifecycleManager,
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, engines, graphs, run_dir, vars0 = _swap_fixture(
+            tmp, n_replicas=1
+        )
+        try:
+            manager = LifecycleManager(registry, engines)
+            live = registry.live
+            save_model(
+                _perturb(vars0, 1e-3, seed=2),
+                None,
+                registry.name,
+                path=tmp,
+                meta={"epoch": 1},
+                keep_last_k=3,
+            )
+            manager.stage_candidate()
+            # The faults layer's seeded corruption, applied to the
+            # candidate's (latest) file — which retention hard-links, so
+            # the chain must walk PAST the identical-inode retained entry
+            # to the intact epoch-0 version.
+            latest = os.path.join(run_dir, registry.name + ".pk")
+            FaultPlan._flip_byte(latest, seed=5)
+            corrupt_before = FaultCounters.get("ckpt_corrupt_detected")
+            refused = False
+            try:
+                manager.promote()
+            except CandidateVerificationError:
+                refused = True
+            corrupt_detected = (
+                FaultCounters.get("ckpt_corrupt_detected") - corrupt_before
+            )
+            still_serving = engines[0].predict([graphs[0]]) is not None
+            fallback_recorded = os.path.exists(
+                os.path.join(run_dir, "supervisor.json")
+            )
+            live_untouched = (
+                engines[0].model_version == live.short
+                and registry.live.version == live.version
+            )
+            return {
+                "ok": refused
+                and live_untouched
+                and corrupt_detected >= 1
+                and still_serving,
+                "promotion_refused": refused,
+                "live_untouched": live_untouched,
+                "ckpt_corrupt_detected": corrupt_detected,
+                "fallback_recorded": fallback_recorded,
+                "live_version": live.short,
+            }
+        finally:
+            for e in engines:
+                e.close()
+
+
+def shadow_gate_drill(requests: int = 12) -> dict:
+    """Shadow gate refuses a deliberately-perturbed candidate: a
+    candidate-version replica mirrors live traffic (never answering
+    callers), the tolerance-gated diffs go red, and ``promote()`` raises
+    ``SwapGateError`` — the live version keeps serving."""
+    import tempfile
+
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.lifecycle import LifecycleManager, SwapGateError
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, engines, graphs, _run_dir, vars0 = _swap_fixture(
+            tmp, n_replicas=1
+        )
+        shadow_engine = None
+        router = None
+        try:
+            live = registry.live
+            # Deliberately bad candidate: a large weight perturbation.
+            bad = _perturb(vars0, 0.5, seed=3)
+            save_model(
+                bad, None, registry.name, path=tmp,
+                meta={"epoch": 1}, keep_last_k=3,
+            )
+            cand = registry.stage_candidate()
+            shadow_engine, _ = build_serving_engine(model_version="pending")
+            shadow_engine.swap_weights(bad, cand.short)
+            router = Router(
+                [InProcessReplica("replica-0", engines[0])],
+                health_interval_s=0.1,
+                jitter_seed=0,
+            )
+            manager = LifecycleManager(registry, engines, router=router)
+            gate = router.set_shadow(
+                InProcessReplica("shadow-candidate", shadow_engine),
+                fraction=1.0,
+                tolerance=1e-6,
+                min_samples=4,
+            )
+            for i in range(requests):
+                router.predict([graphs[i % len(graphs)]], request_id=f"sh-{i}")
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                if gate.report()["compared"] >= gate.min_samples:
+                    break
+                time.sleep(0.02)
+            report = router.shadow_report()
+            refused = False
+            try:
+                manager.promote()
+            except SwapGateError:
+                refused = True
+            return {
+                "ok": refused
+                and not report["green"]
+                and report["failures"] >= 1
+                and engines[0].model_version == live.short,
+                "promotion_refused": refused,
+                "gate": report,
+                "live_version": live.short,
+                "candidate_version": cand.short,
+            }
+        finally:
+            if router is not None:
+                router.close()
+            for e in engines:
+                e.close()
+            if shadow_engine is not None:
+                shadow_engine.close()
+
+
+# Child incarnation of the kill-during-swap drill: promotes the staged
+# candidate; incarnation 0 SIGKILLs itself at the registry's pre-persist
+# hook (AFTER the engines swapped, BEFORE the role table installs) — the
+# supervisor's restart contract (HYDRAGNN_RESTART_COUNT) then reruns it to
+# completion, exactly like the checkpoint kill@save drills.
+_KILL_CHILD_SCRIPT = r"""
+import json, os, signal, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+repo, run_dir, name = sys.argv[1], sys.argv[2], sys.argv[3]
+sys.path.insert(0, repo)
+from benchmarks.serve_load import build_serving_engine
+from hydragnn_tpu.lifecycle import (
+    LifecycleManager, ModelRegistry, set_pre_persist_hook,
+)
+restart = int(os.environ.get("HYDRAGNN_RESTART_COUNT", "0") or 0)
+registry = ModelRegistry(run_dir, name)
+live = registry.live
+engine, _ = build_serving_engine(
+    model_version=live.short if live else "v0"
+)
+manager = LifecycleManager(registry, [engine])
+if registry.candidate is None:
+    registry.stage_candidate()
+if restart == 0:
+    set_pre_persist_hook(
+        lambda doc: os.kill(os.getpid(), signal.SIGKILL)
+    )
+report = manager.promote()
+set_pre_persist_hook(None)
+print("SWAPCHILD " + json.dumps(
+    {"state": registry.state(), "report": report}
+))
+engine.close()
+"""
+
+
+def kill_during_swap_drill() -> dict:
+    """Kill-during-swap via the supervisor's incarnation contract: child 0
+    is SIGKILLed between weight publication and the registry's atomic role
+    install (state stays the OLD table, never torn); the restart
+    incarnation resumes and completes the promotion."""
+    import subprocess
+    import tempfile
+
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.lifecycle import ModelRegistry
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, engines, _graphs, run_dir, vars0 = _swap_fixture(
+            tmp, n_replicas=1
+        )
+        for e in engines:  # the children own their engines
+            e.close()
+        live = registry.live
+        save_model(
+            _perturb(vars0, 1e-3, seed=4),
+            None,
+            registry.name,
+            path=tmp,
+            meta={"epoch": 1},
+            keep_last_k=3,
+        )
+        cand = registry.stage_candidate()
+
+        def child(restart: int):
+            env = dict(os.environ)
+            env["HYDRAGNN_RESTART_COUNT"] = str(restart)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            return subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    _KILL_CHILD_SCRIPT,
+                    REPO,
+                    run_dir,
+                    registry.name,
+                ],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+
+        first = child(0)
+        killed = first.returncode == -9
+        # The role table after the kill must be the OLD one, intact.
+        after_kill = ModelRegistry(run_dir, registry.name).state()["roles"]
+        state_consistent = (
+            after_kill["live"] is not None
+            and after_kill["live"]["version"] == live.version
+            and after_kill["candidate"] is not None
+            and after_kill["candidate"]["version"] == cand.version
+        )
+        second = child(1)
+        resumed = second.returncode == 0 and "SWAPCHILD " in second.stdout
+        final_roles = ModelRegistry(run_dir, registry.name).state()["roles"]
+        promoted = (
+            final_roles["live"] is not None
+            and final_roles["live"]["version"] == cand.version
+            and final_roles["previous"] is not None
+            and final_roles["previous"]["version"] == live.version
+        )
+        return {
+            "ok": killed and state_consistent and resumed and promoted,
+            "child0_returncode": first.returncode,
+            "killed_mid_swap": killed,
+            "state_consistent_after_kill": state_consistent,
+            "resumed": resumed,
+            "promoted_after_restart": promoted,
+            "stderr_tail": ""
+            if resumed
+            else (second.stderr or first.stderr)[-400:],
+        }
+
+
+def run_swap_benchmark(
+    duration_s: float = 1.5,
+    rps: float = 100.0,
+    out_path: "str | None" = None,
+) -> dict:
+    """The live-lifecycle artifact (``SWAP_rNN.json``): swap-under-load +
+    rollback, corrupt-candidate, shadow-gate-rejects, and kill-during-swap
+    drills (ROADMAP item 4's acceptance drills)."""
+    import jax
+
+    block = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": "PNA hidden=8 x2 (graph+node heads)",
+        "offered_graphs_per_sec": rps,
+        "note": "CPU runs measure lifecycle plumbing (swap atomicity, "
+        "version consistency, gates), not TPU latency",
+    }
+    block["swap_under_load"] = swap_under_load_drill(duration_s, rps)
+    block["corrupt_candidate_drill"] = corrupt_candidate_drill()
+    block["shadow_gate_drill"] = shadow_gate_drill()
+    block["kill_during_swap_drill"] = kill_during_swap_drill()
+    drills = [
+        block["swap_under_load"],
+        block["corrupt_candidate_drill"],
+        block["shadow_gate_drill"],
+        block["kill_during_swap_drill"],
+    ]
+    block["drills_total"] = len(drills)
+    block["drills_passed"] = sum(1 for d in drills if d.get("ok"))
+
+    # graftel census: the lifecycle trail (swap/* + serve swap events).
+    from hydragnn_tpu import telemetry
+
+    counts = telemetry.span_counts(telemetry.snapshot_records())
+    block["telemetry"] = {
+        "span_counts": {
+            name: n
+            for name, n in sorted(counts.items())
+            if name.startswith(("swap/", "serve/weights_swapped"))
+        }
+    }
+
+    if out_path is None:
+        out_path = os.path.join(REPO, f"SWAP_r{round_tag()}.json")
+    with open(out_path, "w") as f:
+        json.dump(block, f, indent=2)
+    block["artifact"] = os.path.basename(out_path)
+    return block
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=1.5)
@@ -769,6 +1295,13 @@ def main() -> int:
         help="run the multi-replica router rig instead (fleet open-loop "
         "sweep + kill-a-replica + scale-up-under-load; ROUTER_rNN.json)",
     )
+    ap.add_argument(
+        "--swap",
+        action="store_true",
+        help="run the live-lifecycle rig instead (swap-under-load + "
+        "rollback, corrupt-candidate, shadow-gate, kill-during-swap "
+        "drills; SWAP_rNN.json)",
+    )
     ap.add_argument("--replicas", type=int, default=2)
     args = ap.parse_args()
     loads = (
@@ -776,6 +1309,14 @@ def main() -> int:
         if args.loads
         else None
     )
+    if args.swap:
+        block = run_swap_benchmark(
+            duration_s=args.duration,
+            rps=loads[0] if loads else 100.0,
+            out_path=args.out,
+        )
+        print(json.dumps(block))
+        return 0 if block["drills_passed"] == block["drills_total"] else 1
     if args.router:
         block = run_router_benchmark(
             duration_s=args.duration,

@@ -1,4 +1,5 @@
-"""Deterministic tsan drill over the serve + route + async-checkpoint paths.
+"""Deterministic tsan drill over the serve + route + lifecycle +
+async-checkpoint paths.
 
 Runs the two concurrency-heavy subsystems with graftrace's runtime
 sanitizer enabled (analysis/tsan.py): every registered lock records its
@@ -235,6 +236,51 @@ def _route_drill() -> None:
             engine.close()
 
 
+def _swap_drill(tmpdir: str) -> None:
+    """graftswap path (ISSUE 13): hot weight swaps published from a swapper
+    thread racing the dispatch thread's per-batch weight read and the
+    caller-thread submits — the engine's atomic weight reference under
+    `InferenceEngine._lock` (yield site ``serve.swap.pre_publish`` widens
+    the publish window), plus the ModelRegistry role table and ShadowGate
+    recorders under their own instrumented locks."""
+    import threading
+
+    from benchmarks.serve_load import _host_variables, build_serving_engine
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.lifecycle import ModelRegistry, ShadowGate
+
+    engine, graphs = build_serving_engine(
+        hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0,
+        pool_size=_SERVE_REQUESTS,
+    )
+    try:
+        host = _host_variables(engine)
+
+        def swapper():
+            for k in range(3):
+                engine.swap_weights(host, f"drill-v{k + 1}")
+
+        futures = [engine.submit(g) for g in graphs[:_SERVE_REQUESTS]]
+        t = threading.Thread(target=swapper, name="swap-drill", daemon=True)
+        t.start()
+        for f in futures:
+            f.result(timeout=120)
+        t.join(120)
+        engine.metrics.render_prometheus()  # the /metrics cross-thread read
+        # Registry role flips + sidecar installs under the instrumented
+        # registry lock; the gate's recorders under the gate lock.
+        name = "tsan_swap"
+        save_model(host, None, name, path=tmpdir, keep_last_k=2)
+        registry = ModelRegistry(os.path.join(tmpdir, name), name)
+        registry.set_live()
+        registry.state()
+        gate = ShadowGate(tolerance=1e-3, min_samples=1)
+        gate.record({"ok": True, "fwd_err": 0.0}, candidate_version="drill")
+        gate.render_prometheus()
+    finally:
+        engine.close()
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -244,6 +290,7 @@ def run_drill(seed: int) -> dict:
         _telemetry_drill(tmpdir)
         _cache_drill(tmpdir)
         _route_drill()
+        _swap_drill(tmpdir)
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

@@ -25,6 +25,11 @@ Every failure is one actionable line tagged with a stable code:
                     hash-ring weights / admission classes without deadlines /
                     fleet ladder-memory blowout) — docs/SERVING.md
                     "Multi-replica tier"
+  bad-lifecycle     live-model-lifecycle nonsense (shadow fraction outside
+                    (0, 1], shadow/canary without a tolerance bound, swap
+                    target whose architecture fingerprint mismatches the
+                    serving config, rollback with keep_last_k < 2) —
+                    docs/SERVING.md "Live model lifecycle"
   donation-misuse   config requests a donating step that would alias buffers
   shape-mismatch    eval_shape found inconsistent shapes/dtypes end to end
 
@@ -82,6 +87,7 @@ def check_config(
     serve_precision: Optional[str] = None,
     serve_tolerance: Optional[float] = None,
     router: Optional[Dict[str, Any]] = None,
+    lifecycle: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Validate a training or serving config statically. Returns the report
     dict; with ``strict`` (the default) raises :class:`ConfigContractError`
@@ -95,7 +101,11 @@ def check_config(
     are a ``bad-precision`` finding here, before the checkpoint loads.
     ``router`` is the front-router config dict (the route CLI passes
     ``{"replicas", "classes", "load_factor", "vnodes", ...}``); router
-    nonsense is a ``bad-router`` finding through this same gate."""
+    nonsense is a ``bad-router`` finding through this same gate.
+    ``lifecycle`` is the graftswap config dict
+    (``{"shadow_fraction", "tolerance", "swap_target",
+    "expected_fingerprint", "rollback", "keep_last_k"}``); lifecycle
+    nonsense is a ``bad-lifecycle`` finding through this same gate."""
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
@@ -118,6 +128,8 @@ def check_config(
     _check_buckets(config, arch, training, bucket_ladder, mode, errors)
     if router is not None:
         _check_router(router, bucket_ladder, errors)
+    if lifecycle is not None:
+        _check_lifecycle(lifecycle, arch, training, completed, errors)
     _check_donation(training, errors)
     _check_aggregation_path(arch, errors)
 
@@ -179,6 +191,7 @@ def gate_config(
     serve_precision=None,
     serve_tolerance=None,
     router=None,
+    lifecycle=None,
 ):
     """The ONE entry-point gate shared by run_training / run_prediction /
     serve startup: honors ``HYDRAGNN_CHECK_CONFIG`` (``full`` default,
@@ -198,6 +211,7 @@ def gate_config(
         serve_precision=serve_precision,
         serve_tolerance=serve_tolerance,
         router=router,
+        lifecycle=lifecycle,
     )
 
 
@@ -664,6 +678,125 @@ def _check_router(router, bucket_ladder, errors):
                 "the fleet, or raise router.max_fleet_buckets",
             )
         )
+
+
+def _expected_param_fingerprint(arch) -> Optional[str]:
+    """Param-tree fingerprint of the (completed) serving config's model,
+    via ``jax.eval_shape`` over ``model.init`` — ShapeDtypeStructs only, so
+    nothing compiles and no device memory moves (the same zero-allocation
+    discipline as the eval_shape gate). The fingerprint hashes key paths /
+    shapes / dtypes, which SDS leaves carry."""
+    import jax
+    import numpy as np
+
+    from ..checkpoint.format import param_fingerprint
+    from ..models.create import create_model_config, make_example_batch
+
+    arch2 = dict(arch)
+    arch2.setdefault("freeze_conv_layers", False)
+    model = create_model_config(config=arch2, verbosity=0)
+    example = make_example_batch(
+        arch["input_dim"],
+        arch["output_dim"],
+        arch["output_type"],
+        edge_dim=arch.get("edge_dim"),
+        num_nodes=int(arch.get("num_nodes") or 8),
+    )
+    batch_sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        example,
+    )
+    key_sds = jax.ShapeDtypeStruct((2,), np.uint32)
+    variables = jax.eval_shape(
+        lambda b, k: model.init({"params": k, "dropout": k}, b, train=False),
+        batch_sds,
+        key_sds,
+    )
+    return param_fingerprint(variables["params"])
+
+
+def _check_lifecycle(lifecycle, arch, training, completed, errors):
+    """graftswap config contract (docs/SERVING.md "Live model lifecycle"):
+    shadow-fraction / tolerance / rollback-retention / swap-target nonsense
+    is one actionable ``bad-lifecycle`` line before any engine mutates."""
+    import math
+
+    frac = lifecycle.get("shadow_fraction")
+    if frac is not None:
+        try:
+            f = float(frac)
+        except (TypeError, ValueError):
+            f = float("nan")
+        if not math.isfinite(f) or not (0.0 < f <= 1.0):
+            errors.append(
+                (
+                    "bad-lifecycle",
+                    f"shadow fraction must be in (0, 1], got {frac!r} — 0 "
+                    "mirrors nothing (the gate can never go green) and >1 "
+                    "is not a sampling fraction",
+                )
+            )
+        tol = lifecycle.get("tolerance")
+        if (
+            not isinstance(tol, (int, float))
+            or isinstance(tol, bool)
+            or not math.isfinite(float(tol))
+            or tol <= 0
+        ):
+            errors.append(
+                (
+                    "bad-lifecycle",
+                    "shadow/canary serving requires a positive tolerance "
+                    "bound (the diff gate's definition of 'matches live'); "
+                    f"got {tol!r}",
+                )
+            )
+    if lifecycle.get("rollback"):
+        k = lifecycle.get(
+            "keep_last_k", training.get("checkpoint_keep_last_k")
+        )
+        if not isinstance(k, int) or isinstance(k, bool) or k < 2:
+            errors.append(
+                (
+                    "bad-lifecycle",
+                    f"rollback requires checkpoint_keep_last_k >= 2 (got "
+                    f"{k!r}) — the previous version must still exist in the "
+                    "retention manifest to be restorable",
+                )
+            )
+    target = lifecycle.get("swap_target")
+    if target:
+        fp = None
+        try:
+            from ..checkpoint.format import file_content_identity
+
+            _identity, header = file_content_identity(str(target))
+            fp = header.get("param_fingerprint")
+        except Exception as e:  # noqa: BLE001 — every read failure is a finding
+            errors.append(
+                (
+                    "bad-lifecycle",
+                    f"swap target {target!r} is not a verifiable v2 "
+                    f"checkpoint: {e}",
+                )
+            )
+        if fp:
+            expected = lifecycle.get("expected_fingerprint")
+            if expected is None and completed:
+                try:
+                    expected = _expected_param_fingerprint(arch)
+                except Exception:  # noqa: BLE001 — bad-arch reported elsewhere
+                    expected = None
+            if expected and fp != expected:
+                errors.append(
+                    (
+                        "bad-lifecycle",
+                        f"swap target {target!r} was saved from a different "
+                        "architecture than the serving config (param-tree "
+                        "fingerprint mismatch) — a hot swap is weights-only; "
+                        "an architecture change needs a replica rebuild",
+                    )
+                )
 
 
 def _check_buckets(config, arch, training, bucket_ladder, mode, errors):

@@ -30,12 +30,15 @@ from .format import (
     CheckpointChainExhaustedError,
     CheckpointCorruptError,
     CheckpointError,
+    content_identity,
+    file_content_identity,
     param_fingerprint,
 )
 from .io import (
     atomic_write_json,
     checkpoint_exists,
     cleanup_stale_checkpoint_tmp,
+    load_checkpoint_bytes,
     load_checkpoint_file,
     load_checkpoint_manifest,
     load_checkpoint_meta,
@@ -63,6 +66,9 @@ __all__ = [
     "CheckpointError",
     "checkpoint_exists",
     "cleanup_stale_checkpoint_tmp",
+    "content_identity",
+    "file_content_identity",
+    "load_checkpoint_bytes",
     "load_checkpoint_file",
     "load_checkpoint_manifest",
     "load_checkpoint_meta",

@@ -116,6 +116,35 @@ def param_fingerprint(params) -> str:
     return hashlib.sha256(desc.encode()).hexdigest()
 
 
+def content_identity(blob: bytes, path: str = "<bytes>") -> Tuple[str, Dict[str, Any]]:
+    """Digest-verified CONTENT identity of one v2 checkpoint blob →
+    ``(identity_hex, header)``. The identity is sha256 over the sorted
+    per-section digest map (header blob included), so two checkpoints share
+    an identity iff their verified bytes agree section for section — the
+    model-version identity of the lifecycle layer (docs/CHECKPOINTING.md
+    "Version identity"; ``param_fingerprint`` deliberately cannot serve
+    here: it hashes the tree STRUCTURE, which every retrain of the same
+    architecture shares). Raises :class:`CheckpointCorruptError` exactly
+    like :func:`decode` — an identity is only ever computed over bytes that
+    verified."""
+    header, sections = decode(blob, path)
+    digests = {k: hashlib.sha256(v).hexdigest() for k, v in sections.items()}
+    desc = ";".join(f"{k}:{v}" for k, v in sorted(digests.items()))
+    return hashlib.sha256(desc.encode()).hexdigest(), header
+
+
+def file_content_identity(path: str) -> Tuple[str, Dict[str, Any]]:
+    """:func:`content_identity` of a checkpoint FILE (reads + verifies).
+    Unreadable files surface as :class:`CheckpointCorruptError` so callers
+    have one failure class for 'this is not a loadable version'."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(path, f"unreadable ({e})") from e
+    return content_identity(blob, path)
+
+
 def encode(
     sections: Dict[str, Optional[bytes]], header: Optional[Dict[str, Any]] = None
 ) -> bytes:

@@ -282,18 +282,25 @@ def _warn_v1_once(path_name: str) -> None:
 
 
 def read_checkpoint_payload(path_name: str) -> Tuple[int, Dict[str, Any]]:
-    """Raw payload of one checkpoint file → (format_version, payload) where
+    """Raw payload of one checkpoint file → (format_version, payload);
+    see :func:`payload_from_blob`."""
+    try:
+        with open(path_name, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(path_name, f"unreadable ({e})") from e
+    return payload_from_blob(blob, path_name)
+
+
+def payload_from_blob(blob: bytes, path_name: str = "<bytes>") -> Tuple[int, Dict[str, Any]]:
+    """Raw payload of one checkpoint BLOB → (format_version, payload) where
     payload is the v1-shaped dict {params: bytes, batch_stats: bytes,
     opt_state: bytes|None, meta: dict, header: dict}. Integrity-verifies v2
     digests; wraps every v1 pickle failure as CheckpointCorruptError so the
-    fallback chain can classify it."""
-    try:
-        with open(path_name, "rb") as f:
-            head = f.read(len(ckpt_format.MAGIC))
-            rest = f.read()
-    except OSError as e:
-        raise CheckpointCorruptError(path_name, f"unreadable ({e})") from e
-    blob = head + rest
+    fallback chain can classify it. Split from the file reader so callers
+    that already hold the bytes (the lifecycle registry's one-read
+    identity+load path) never re-read — identity and deserialization then
+    provably attest the SAME bytes."""
     if ckpt_format.is_v2_blob(blob):
         header, sections = ckpt_format.decode(blob, path_name)
         meta = (
@@ -336,6 +343,30 @@ def load_checkpoint_file(
     before deserializing; raises CheckpointCorruptError on integrity
     failures. Returns (variables, opt_state, meta)."""
     version, payload = read_checkpoint_payload(path_name)
+    return _deserialize_payload(variables, version, payload, path_name, opt_state)
+
+
+def load_checkpoint_bytes(
+    variables: Dict[str, Any],
+    blob: bytes,
+    path_name: str = "<bytes>",
+    opt_state: Any = None,
+):
+    """:func:`load_checkpoint_file` over in-memory bytes — one read shared
+    between identity computation and deserialization (the lifecycle
+    registry's TOCTOU-free candidate load: a trainer overwriting the file
+    between the two cannot desync what was verified from what was loaded)."""
+    version, payload = payload_from_blob(blob, path_name)
+    return _deserialize_payload(variables, version, payload, path_name, opt_state)
+
+
+def _deserialize_payload(
+    variables: Dict[str, Any],
+    version: int,
+    payload: Dict[str, Any],
+    path_name: str,
+    opt_state: Any = None,
+):
     fp = payload["header"].get("param_fingerprint")
     if version >= 2 and fp:
         want = ckpt_format.param_fingerprint(variables["params"])

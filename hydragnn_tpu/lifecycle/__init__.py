@@ -1,0 +1,51 @@
+"""graftswap — zero-downtime live model lifecycle (docs/SERVING.md "Live
+model lifecycle"; ROADMAP item 4).
+
+Model updates become a metrics decision instead of a restart:
+
+* :mod:`.registry` — versioned model registry over the checkpoint layer: a
+  model version IS a v2 digest-verified checkpoint (content identity =
+  sha256 over the verified section digests), roles (live / candidate /
+  previous) tracked over the ``keep_last_k`` manifest with an atomic
+  ``<name>.lifecycle.json`` sidecar;
+* :mod:`.shadow` — the tolerance-gated shadow diff gate the router's
+  mirror arm feeds (``hydragnn_swap_*`` metrics); promotion requires it
+  green;
+* :mod:`.manager` — promote()/rollback() orchestration: verified load →
+  ``engine.swap_weights`` (atomic, per-request-consistent, zero
+  recompiles) on every replica → registry role flip.
+
+The engine half (``InferenceEngine.swap_weights``, per-response
+``model_version`` tags, the ``X-HydraGNN-Model-Version`` header) lives in
+``hydragnn_tpu/serve``; the traffic-mirroring half (``Router.set_shadow``)
+in ``hydragnn_tpu/route``.
+"""
+
+from .manager import LifecycleManager
+from .registry import (
+    ROLE_CANDIDATE,
+    ROLE_LIVE,
+    ROLE_PREVIOUS,
+    CandidateVerificationError,
+    LifecycleError,
+    ModelRegistry,
+    ModelVersion,
+    SwapGateError,
+    set_pre_persist_hook,
+)
+from .shadow import ShadowGate, compare_outputs
+
+__all__ = [
+    "ROLE_CANDIDATE",
+    "ROLE_LIVE",
+    "ROLE_PREVIOUS",
+    "CandidateVerificationError",
+    "LifecycleError",
+    "LifecycleManager",
+    "ModelRegistry",
+    "ModelVersion",
+    "ShadowGate",
+    "SwapGateError",
+    "compare_outputs",
+    "set_pre_persist_hook",
+]

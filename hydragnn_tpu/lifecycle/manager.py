@@ -1,0 +1,221 @@
+"""LifecycleManager — promotion as a metrics decision, not a restart
+(docs/SERVING.md "Live model lifecycle"; ROADMAP item 4).
+
+One manager owns a :class:`~hydragnn_tpu.lifecycle.registry.ModelRegistry`
+and the live fleet's engines (optionally the front router, for the shadow
+gate). The loop it closes::
+
+    trainer writes checkpoint              (checkpoint/io.save_model)
+      → stage_candidate()                  (digest-verified identity)
+      → router.set_shadow(candidate arm)   (mirrored traffic, diff gate)
+      → promote()                          (gate green → verified load →
+                                            engine.swap_weights on every
+                                            replica → registry role flip)
+      → rollback()                         (previous ↔ live, one swap)
+
+Every step is refusal-first: a corrupt candidate is caught by the verified
+chain (the fleet keeps serving, ``ckpt_corrupt_detected`` counts it), a red
+shadow gate raises :class:`SwapGateError`, a wrong-architecture candidate is
+rejected by the engine's fingerprint check, and a quantized arm that fails
+its post-swap tolerance gate reverts inside ``swap_weights``. Only after
+every engine swapped does the registry's role table flip (atomic sidecar
+install) — a kill anywhere in between leaves either the old or the new
+table, which the kill-during-swap drill asserts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..telemetry import graftel as telemetry
+from .registry import (
+    LifecycleError,
+    ModelRegistry,
+    ModelVersion,
+    SwapGateError,
+)
+
+
+class LifecycleManager:
+    """Promote/rollback orchestration over a registry + engine fleet.
+
+    Parameters
+    ----------
+    registry:
+        The run's :class:`ModelRegistry`.
+    engines:
+        The live fleet's ``InferenceEngine`` objects (in-process replicas;
+        an HTTP fleet drives the same API per-process). All must serve the
+        same architecture — the swap validates it per engine.
+    router:
+        Optional front ``Router``. When it has a shadow arm configured,
+        :meth:`promote` requires the shadow gate green (``force=True``
+        overrides, loudly) and clears the shadow on success.
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        engines: Sequence[Any],
+        router: Optional[Any] = None,
+    ):
+        if not engines:
+            raise ValueError("LifecycleManager needs at least one engine")
+        self.registry = registry
+        self.engines: List[Any] = list(engines)
+        self.router = router
+
+    # ---------------------------------------------------------------- helpers
+    def _template(self) -> Dict[str, Any]:
+        """Variables template for verified loads: the first engine's weight
+        structure (flax ``from_bytes`` restores onto it; values ignored).
+        For quantized arms the engine's f32 reference is the honest
+        template — the served params carry the same tree either way."""
+        engine = self.engines[0]
+        ref = getattr(engine, "_ref_variables", None)
+        if ref is not None:
+            return ref
+        params, bstats, _v = engine._current_weights()
+        return {"params": params, "batch_stats": bstats}
+
+    def _swap_all(self, variables: Dict[str, Any], version: ModelVersion) -> float:
+        """Swap every engine, or none: a failure on replica k (worker death,
+        per-engine gate refusal) republishes the pre-swap weights on
+        replicas 0..k-1 before re-raising — the fleet is never left
+        version-torn against a role table that did not flip."""
+        t0 = time.perf_counter()
+        previous = [engine._current_weights() for engine in self.engines]
+        done = 0
+        try:
+            for engine in self.engines:
+                engine.swap_weights(variables, version.short)
+                done += 1
+        except BaseException:
+            for engine, weights in zip(self.engines[:done], previous[:done]):
+                engine.restore_weights(weights)
+            telemetry.event(
+                "swap/fleet_unwound", version=version.short, swapped=done
+            )
+            raise
+        return time.perf_counter() - t0
+
+    def shadow_report(self) -> Optional[Dict[str, Any]]:
+        """The router's shadow-gate snapshot (None when no router or no
+        shadow arm is configured)."""
+        if self.router is None:
+            return None
+        report = self.router.shadow_report()
+        return report if report.get("configured") else None
+
+    # ------------------------------------------------------------------ steps
+    def stage_candidate(self, path: Optional[str] = None) -> ModelVersion:
+        """Verify + stage a candidate (default: the run's latest
+        checkpoint). See :meth:`ModelRegistry.stage_candidate`."""
+        return self.registry.stage_candidate(path)
+
+    def promote(self, force: bool = False) -> Dict[str, Any]:
+        """Flip live → candidate, gated and verified end to end. Returns
+        {version, previous_version, swap_wall_s, gate, epochs}. Raises:
+
+        * :class:`LifecycleError` — no candidate staged;
+        * :class:`SwapGateError` — shadow gate configured but not green
+          (``force=True`` promotes anyway, recorded in the report);
+        * :class:`CandidateVerificationError` — the candidate's bytes no
+          longer verify as the staged identity (corruption → the chain
+          recovered something else; live weights untouched);
+        * ``SwapFingerprintError`` / ``PrecisionToleranceError`` — engine
+          refusals (architecture mismatch / failed post-swap gate).
+        """
+        candidate = self.registry.candidate
+        if candidate is None:
+            raise LifecycleError(
+                "promote() with no staged candidate — call "
+                "stage_candidate() first"
+            )
+        gate = self.shadow_report()
+        if gate is not None and not gate.get("green") and not force:
+            telemetry.event(
+                "swap/promotion_refused",
+                version=candidate.short,
+                reason="shadow_gate_red",
+            )
+            raise SwapGateError(
+                f"promotion of {candidate.short} refused: shadow gate is "
+                f"not green ({gate.get('compared', 0)} compared, "
+                f"{gate.get('failures', 0)} failure(s), "
+                f"{gate.get('errors', 0)} error(s), need "
+                f">= {gate.get('min_samples')} clean comparisons)",
+                report=gate,
+            )
+        variables, meta, loaded = self.registry.load_role(
+            "candidate", self._template()
+        )
+        old_live = self.registry.live
+        previous_weights = [e._current_weights() for e in self.engines]
+        wall = self._swap_all(variables, loaded)
+        try:
+            self.registry.commit_promote(loaded)
+        except BaseException:
+            # The role table did not flip (concurrent candidate change, a
+            # failed sidecar install): un-publish the already-swapped fleet
+            # — engines must never serve a version the registry does not
+            # record as live.
+            for engine, weights in zip(self.engines, previous_weights):
+                engine.restore_weights(weights)
+            telemetry.event("swap/fleet_unwound", version=loaded.short)
+            raise
+        if self.router is not None:
+            self.router.clear_shadow()
+        report = {
+            "version": loaded.short,
+            "previous_version": old_live.short if old_live else None,
+            "swap_wall_s": round(wall, 4),
+            "gate": gate,
+            "forced": bool(force and gate is not None and not gate.get("green")),
+            "epoch": meta.get("epoch"),
+        }
+        telemetry.event(
+            "swap/promote_complete",
+            version=loaded.short,
+            swap_wall_s=report["swap_wall_s"],
+        )
+        return report
+
+    def rollback(self) -> Dict[str, Any]:
+        """Restore the ``previous`` version in ONE swap (kept addressable by
+        ``keep_last_k >= 2`` retention). Zero compiles by construction —
+        same param tree, same executables. Returns the swap report."""
+        previous = self.registry.previous
+        if previous is None:
+            raise LifecycleError(
+                "rollback() with no previous version — nothing was ever "
+                "promoted over the current live version (rollback also "
+                "needs checkpoint_keep_last_k >= 2 so the previous file "
+                "still exists; contracts.py flags bad-lifecycle otherwise)"
+            )
+        variables, meta, loaded = self.registry.load_role(
+            "previous", self._template()
+        )
+        old_live = self.registry.live
+        previous_weights = [e._current_weights() for e in self.engines]
+        wall = self._swap_all(variables, loaded)
+        try:
+            self.registry.commit_rollback(loaded)
+        except BaseException:
+            for engine, weights in zip(self.engines, previous_weights):
+                engine.restore_weights(weights)
+            telemetry.event("swap/fleet_unwound", version=loaded.short)
+            raise
+        report = {
+            "version": loaded.short,
+            "previous_version": old_live.short if old_live else None,
+            "swap_wall_s": round(wall, 4),
+            "epoch": meta.get("epoch"),
+        }
+        telemetry.event(
+            "swap/rollback_complete",
+            version=loaded.short,
+            swap_wall_s=report["swap_wall_s"],
+        )
+        return report

@@ -1,0 +1,453 @@
+"""graftswap model registry — versioned model identities over the checkpoint
+layer (docs/SERVING.md "Live model lifecycle", docs/CHECKPOINTING.md
+"Version identity").
+
+A **model version IS a v2 digest-verified checkpoint**: its identity is the
+sha256 over the container's verified per-section digest map
+(``checkpoint/format.content_identity``) — deterministic serialization means
+the same weights always carry the same identity, and nothing about a version
+can be trusted before its digests verify. The registry tracks three ROLES
+over one run directory's checkpoint set (``<name>.pk`` latest + the
+``keep_last_k`` retention manifest, checkpoint/io.py):
+
+* ``live``      — the version the serve tier currently answers with;
+* ``candidate`` — a staged version awaiting shadow-gated promotion;
+* ``previous``  — the last live version, kept addressable for instant
+  rollback (which is why rollback requires ``keep_last_k >= 2`` —
+  contracts.py ``bad-lifecycle``).
+
+Role state persists in an atomically-installed ``<name>.lifecycle.json``
+sidecar (same fsync'd unique-tmp contract as the retention manifest), so a
+kill at ANY point during a promote/rollback leaves either the old or the new
+role table — never a torn one. The kill-during-swap drill SIGKILLs a process
+between weight publication and state persistence and asserts exactly that.
+
+Every load path rides the checkpoint layer's verified machinery:
+
+* live/candidate loads ride :func:`checkpoint.io.load_verified_chain` when
+  they target the latest file — a corrupt candidate FALLS BACK LOUDLY
+  (``ckpt_corrupt_detected`` counter, supervisor.json record, flight dump)
+  and the registry then REFUSES the promotion because the recovered
+  identity is not the staged candidate's (the live version stays
+  untouched);
+* explicit-file loads use :func:`checkpoint.io.load_checkpoint_file`
+  (digest-verified, corrupt → loud raise, counted here).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis import tsan
+from ..checkpoint import format as ckpt_format
+from ..checkpoint.format import CheckpointCorruptError, CheckpointError
+from ..checkpoint.io import (
+    atomic_write_json,
+    load_checkpoint_bytes,
+    load_checkpoint_file,
+    load_verified_chain,
+)
+from ..telemetry import graftel as telemetry
+
+ROLE_LIVE = "live"
+ROLE_CANDIDATE = "candidate"
+ROLE_PREVIOUS = "previous"
+ROLES = (ROLE_LIVE, ROLE_CANDIDATE, ROLE_PREVIOUS)
+
+STATE_SUFFIX = ".lifecycle.json"
+
+
+class LifecycleError(RuntimeError):
+    """Base class for model-lifecycle failures (registry/manager/gate)."""
+
+
+class CandidateVerificationError(LifecycleError):
+    """The staged candidate could not be loaded AS ITSELF: the verified
+    chain fell back to a different (intact) version, or the explicit file's
+    identity changed since staging. Promotion is refused; the live version
+    is untouched. ``loaded_version`` names what the chain recovered (None
+    when nothing loaded)."""
+
+    def __init__(self, message: str, loaded_version: Optional[str] = None):
+        super().__init__(message)
+        self.loaded_version = loaded_version
+
+
+class SwapGateError(LifecycleError):
+    """Promotion refused by a gate (shadow diff gate not green, or a
+    post-swap tolerance gate failure already reverted the weights). Carries
+    the gate ``report``."""
+
+    def __init__(self, message: str, report: Optional[dict] = None):
+        super().__init__(message)
+        self.report = report or {}
+
+
+@dataclass(frozen=True)
+class ModelVersion:
+    """One addressable model version: verified content identity + where its
+    bytes live. ``fingerprint`` is the param-TREE fingerprint (architecture
+    identity) the engine's swap validation compares against."""
+
+    version: str
+    file: str
+    path: str
+    epoch: Optional[int]
+    fingerprint: str
+
+    @property
+    def short(self) -> str:
+        """12-hex display/annotation form — what responses and /healthz
+        carry (the full identity stays in the registry state)."""
+        return self.version[:12]
+
+
+# ------------------------------------------------------------------ drill hook
+# Pre-persist hook (mirrors checkpoint/io.set_post_save_hook): invoked with
+# the role-table dict RIGHT BEFORE each atomic state install. The
+# kill-during-swap drill registers a SIGKILL here (incarnation-0 gated by the
+# drill itself) to prove a death between weight publication and state
+# persistence leaves a consistent registry.
+_pre_persist_hook: Optional[Callable[[Dict[str, Any]], None]] = None
+
+
+def set_pre_persist_hook(hook: Optional[Callable[[Dict[str, Any]], None]]) -> None:
+    global _pre_persist_hook
+    _pre_persist_hook = hook
+
+
+class ModelRegistry:
+    """Role-tracked model versions over one run directory.
+
+    Thread-safety: the role table is read by serving-side status surfaces
+    while the manager mutates it on promote/rollback — every access to
+    ``_roles`` holds ``_lock`` (``# guarded-by:`` annotated, graftrace- and
+    tsan-checked; the lock is registered with the runtime sanitizer)."""
+
+    def __init__(self, run_dir: str, name: str):
+        self.run_dir = run_dir
+        self.name = name
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "ModelRegistry._lock"
+        )
+        # Role table: role -> ModelVersion dict (the sidecar's schema).
+        self._roles: Dict[str, Optional[Dict[str, Any]]] = {  # guarded-by: self._lock
+            r: None for r in ROLES
+        }
+        self._load_state()
+
+    # -------------------------------------------------------------- identity
+    def identify(self, path: str) -> ModelVersion:
+        """Digest-verified :class:`ModelVersion` of one checkpoint file.
+        Corruption is COUNTED (``ckpt_corrupt_detected``) and raised — an
+        unverifiable file is never a version."""
+        from ..faults import FaultCounters
+
+        try:
+            identity, header = ckpt_format.file_content_identity(path)
+        except CheckpointCorruptError:
+            FaultCounters.inc("ckpt_corrupt_detected")
+            telemetry.event("swap/candidate_corrupt", file=os.path.basename(path))
+            raise
+        return ModelVersion(
+            version=identity,
+            file=os.path.basename(path),
+            path=path,
+            epoch=header.get("epoch"),
+            fingerprint=header.get("param_fingerprint") or "",
+        )
+
+    def versions(self) -> List[ModelVersion]:
+        """Every verifiable version addressable from this run dir (latest +
+        manifest entries), newest first, deduplicated by identity. Corrupt
+        entries are skipped here (scan is an inventory, not a load — the
+        load paths fail loudly)."""
+        import json
+
+        seen: Dict[str, ModelVersion] = {}
+        candidates = [os.path.join(self.run_dir, self.name + ".pk")]
+        manifest_path = os.path.join(
+            self.run_dir, self.name + ".manifest.json"
+        )
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            manifest = {}
+        entries = sorted(
+            manifest.get("entries", []),
+            key=lambda e: e.get("serial", 0),
+            reverse=True,
+        )
+        candidates += [os.path.join(self.run_dir, e["file"]) for e in entries]
+        for path in candidates:
+            if not os.path.exists(path):
+                continue
+            try:
+                mv = self.identify(path)
+            except CheckpointError:
+                continue
+            seen.setdefault(mv.version, mv)
+        return list(seen.values())
+
+    def _stabilize(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Prefer a retained epoch-tagged hard link over the volatile
+        ``<name>.pk`` path in ROLE records: the latest file is overwritten
+        by every subsequent save, while the retained file is this exact
+        version's stable address (same inode at retention time, same
+        verified identity here). Candidates deliberately stay on the latest
+        path — that is what routes their load through the fallback chain."""
+        import json
+
+        latest = os.path.join(self.run_dir, self.name + ".pk")
+        if os.path.abspath(doc["path"]) != os.path.abspath(latest):
+            return doc
+        manifest_path = os.path.join(
+            self.run_dir, self.name + ".manifest.json"
+        )
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return doc
+        for entry in sorted(
+            manifest.get("entries", []),
+            key=lambda e: e.get("serial", 0),
+            reverse=True,
+        ):
+            path = os.path.join(self.run_dir, entry["file"])
+            if not os.path.exists(path):
+                continue
+            try:
+                mv = self.identify(path)
+            except CheckpointError:
+                continue
+            if mv.version == doc["version"]:
+                return asdict(mv)
+        return doc
+
+    # ------------------------------------------------------------------ roles
+    def _get_role(self, role: str) -> Optional[ModelVersion]:
+        with self._lock:
+            doc = self._roles.get(role)
+        return ModelVersion(**doc) if doc else None
+
+    @property
+    def live(self) -> Optional[ModelVersion]:
+        return self._get_role(ROLE_LIVE)
+
+    @property
+    def candidate(self) -> Optional[ModelVersion]:
+        return self._get_role(ROLE_CANDIDATE)
+
+    @property
+    def previous(self) -> Optional[ModelVersion]:
+        return self._get_role(ROLE_PREVIOUS)
+
+    def state(self) -> Dict[str, Any]:
+        """Locked snapshot of the role table (the /healthz-adjacent status
+        surface and the drills' assertion target)."""
+        with self._lock:
+            roles = {r: dict(d) if d else None for r, d in self._roles.items()}
+        return {"name": self.name, "run_dir": self.run_dir, "roles": roles}
+
+    # ---------------------------------------------------------------- staging
+    def set_live(self, path: Optional[str] = None) -> ModelVersion:
+        """Declare the currently-served version (boot-time registration:
+        engines built from a checkpoint call this once so promote/rollback
+        have an anchored starting point)."""
+        mv = self.identify(path or os.path.join(self.run_dir, self.name + ".pk"))
+        doc = self._stabilize(asdict(mv))
+        with self._lock:
+            self._roles[ROLE_LIVE] = doc
+        self._persist()
+        return ModelVersion(**doc)
+
+    def stage_candidate(self, path: Optional[str] = None) -> ModelVersion:
+        """Verify + stage a candidate version (default: the run's latest
+        ``<name>.pk`` — the checkpoint the trainer just wrote). A candidate
+        identical to live is refused: promoting it would be a no-op swap
+        that still churns the role table."""
+        mv = self.identify(path or os.path.join(self.run_dir, self.name + ".pk"))
+        live = self.live
+        if live is not None and live.version == mv.version:
+            raise LifecycleError(
+                f"candidate {mv.short} is already the live version — "
+                "nothing to promote"
+            )
+        with self._lock:
+            self._roles[ROLE_CANDIDATE] = asdict(mv)
+        self._persist()
+        telemetry.event(
+            "swap/candidate_staged", version=mv.short, file=mv.file
+        )
+        return mv
+
+    # ------------------------------------------------------------------ loads
+    def load_role(
+        self, role: str, variables: Dict[str, Any]
+    ) -> Tuple[Dict[str, Any], Dict[str, Any], ModelVersion]:
+        """Verified load of the version holding ``role`` onto a variables
+        template → ``(variables, meta, loaded_version)``.
+
+        The latest file loads through :func:`load_verified_chain` (corrupt →
+        loud fallback walk); any OTHER retained file loads directly
+        (digest-verified). Either way the LOADED bytes' identity must match
+        the role's staged identity — a mismatch (the chain recovered some
+        other intact version) raises :class:`CandidateVerificationError`
+        and the caller's live weights stay untouched."""
+        want = self._get_role(role)
+        if want is None:
+            raise LifecycleError(
+                f"no {role!r} version is registered for {self.name!r}"
+            )
+        latest = os.path.join(self.run_dir, self.name + ".pk")
+        if os.path.abspath(want.path) == os.path.abspath(latest):
+            # ONE read of the latest file: identity and deserialization
+            # attest the same bytes (a trainer overwriting <name>.pk between
+            # a load and a re-read could otherwise desync them). An intact
+            # blob with the staged identity loads directly; anything else
+            # goes through the loud machinery below.
+            blob: Optional[bytes] = None
+            identity: Optional[str] = None
+            try:
+                with open(latest, "rb") as f:
+                    blob = f.read()
+                identity, _header = ckpt_format.content_identity(blob, latest)
+            except CheckpointCorruptError:
+                pass  # counted + recovered via the verified chain below
+            if blob is not None and identity == want.version:
+                new_vars, _opt, meta = load_checkpoint_bytes(
+                    variables, blob, latest
+                )
+                return new_vars, meta, want
+            if identity is not None:
+                # Intact but DIFFERENT bytes: the trainer overwrote the
+                # latest since staging — not corruption, but not the staged
+                # candidate either. Refuse; re-stage to pick up the new one.
+                raise CandidateVerificationError(
+                    f"{role} file {want.file} changed since staging "
+                    f"(staged {want.short}, on disk {identity[:12]}) — "
+                    "refusing to serve a version nobody staged",
+                    loaded_version=identity,
+                )
+            # Corrupt latest: walk the verified chain LOUDLY (it counts
+            # every corrupt entry into ckpt_corrupt_detected and records the
+            # fallback in supervisor.json + a flight dump). Whatever intact
+            # version it recovers cannot be the staged candidate, so the
+            # promotion is refused — the point of the corrupt-candidate
+            # drill.
+            telemetry.event(
+                "swap/candidate_corrupt", file=os.path.basename(latest)
+            )
+            new_vars, _opt, meta, report = load_verified_chain(
+                variables, self.run_dir, self.name
+            )
+            loaded_path = (
+                latest
+                if report is None
+                else os.path.join(self.run_dir, report["fallback_file"])
+            )
+            loaded = self.identify(loaded_path)
+            raise CandidateVerificationError(
+                f"{role} version {want.short} ({want.file}) failed "
+                f"verification; the fallback chain recovered "
+                f"{loaded.short} ({loaded.file}) instead — refusing to "
+                f"serve a version nobody staged",
+                loaded_version=loaded.version,
+            )
+        # Retained/explicit file: one verified read, no chain.
+        try:
+            loaded = self.identify(want.path)
+        except CheckpointCorruptError as e:
+            raise CandidateVerificationError(
+                f"{role} version {want.short} ({want.file}) is corrupt: "
+                f"{e.reason}",
+            ) from e
+        if loaded.version != want.version:
+            raise CandidateVerificationError(
+                f"{role} file {want.file} changed since staging "
+                f"(staged {want.short}, on disk {loaded.short})",
+                loaded_version=loaded.version,
+            )
+        new_vars, _opt, meta = load_checkpoint_file(variables, want.path)
+        return new_vars, meta, loaded
+
+    # ------------------------------------------------------------ role flips
+    def commit_promote(self, version: ModelVersion) -> None:
+        """candidate → live, live → previous — one atomic sidecar install.
+        ``version`` must be the staged candidate (the manager passes the
+        identity it actually loaded and swapped)."""
+        with self._lock:
+            cand = self._roles.get(ROLE_CANDIDATE)
+            if not cand or cand["version"] != version.version:
+                raise LifecycleError(
+                    f"commit_promote({version.short}) does not match the "
+                    "staged candidate"
+                )
+        # The new live's stable address (retained hard link, not the
+        # soon-to-be-overwritten latest) — resolved outside the lock (file
+        # reads), then committed.
+        stable = self._stabilize(cand)
+        with self._lock:
+            if self._roles.get(ROLE_CANDIDATE) != cand:
+                raise LifecycleError(
+                    "candidate changed concurrently during commit_promote"
+                )
+            self._roles[ROLE_PREVIOUS] = self._roles.get(ROLE_LIVE)
+            self._roles[ROLE_LIVE] = stable
+            self._roles[ROLE_CANDIDATE] = None
+        self._persist()
+        telemetry.event("swap/promoted", version=version.short)
+
+    def commit_rollback(self, version: ModelVersion) -> None:
+        """live ↔ previous — one atomic sidecar install. Keeping the
+        rolled-back version addressable as ``previous`` lets an operator
+        roll FORWARD again after the underlying issue is fixed."""
+        with self._lock:
+            prev = self._roles.get(ROLE_PREVIOUS)
+            if not prev or prev["version"] != version.version:
+                raise LifecycleError(
+                    f"commit_rollback({version.short}) does not match the "
+                    "recorded previous version"
+                )
+            self._roles[ROLE_PREVIOUS] = self._roles.get(ROLE_LIVE)
+            self._roles[ROLE_LIVE] = prev
+        self._persist()
+        telemetry.event("swap/rolled_back", version=version.short)
+
+    # ------------------------------------------------------------ persistence
+    def _state_path(self) -> str:
+        return os.path.join(self.run_dir, self.name + STATE_SUFFIX)
+
+    def _load_state(self) -> None:
+        import json
+
+        try:
+            with open(self._state_path()) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        roles = doc.get("roles") or {}
+        with self._lock:
+            for role in ROLES:
+                rec = roles.get(role)
+                if isinstance(rec, dict) and rec.get("version"):
+                    self._roles[role] = rec
+
+    def _persist(self) -> None:
+        with self._lock:
+            roles = {r: dict(d) if d else None for r, d in self._roles.items()}
+        doc = {
+            "name": self.name,
+            "roles": roles,
+            "updated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        hook = _pre_persist_hook
+        if hook is not None:
+            hook(doc)
+        atomic_write_json(self._state_path(), doc)

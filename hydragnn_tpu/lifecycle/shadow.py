@@ -1,0 +1,153 @@
+"""Shadow diff gate — tolerance-gated live-vs-candidate output comparison
+(docs/SERVING.md "Live model lifecycle", docs/OBSERVABILITY.md
+"hydragnn_swap_*").
+
+The router mirrors a sampled fraction of live traffic to a candidate-version
+replica (route/router.py shadow mode); every mirrored call's outputs are
+compared against the LIVE answer the caller already received, through the
+same tolerance machinery the quantized serving arm and kernel certification
+use (precision/tolerance.py — one definition of "within tolerance" across
+the whole stack). This module holds the cross-thread accounting:
+
+* :class:`ShadowGate` — the locked pass/fail record
+  (``# guarded-by:``-annotated; observations arrive from the router's
+  shadow worker thread, reads from caller threads and /metrics scrapes).
+  The gate is **green** only once ``min_samples`` comparisons completed
+  with ZERO tolerance failures — ``LifecycleManager.promote`` refuses a
+  promotion whose gate is not green.
+* :func:`compare_outputs` — per-graph per-head max-abs-diff verdict over a
+  whole mirrored call (the worst head anywhere decides).
+
+Shadow responses are NEVER returned to callers and NEVER counted against
+SLO admission; a shadow replica that errors or a full mirror queue degrades
+the GATE (errors/dropped counters), not live traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..analysis import tsan
+from ..precision.tolerance import tolerance_report
+
+
+def compare_outputs(
+    live: Sequence[Sequence[Any]],
+    shadow: Sequence[Sequence[Any]],
+    bound: float,
+    names: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """One mirrored call's verdict: per-graph ``tolerance_report`` (heads
+    vs heads), reduced to the worst graph. Shape disagreements raise — a
+    candidate emitting different head shapes is a staging error the gate
+    must surface loudly, not average away."""
+    if len(live) != len(shadow):
+        raise ValueError(
+            f"mirrored call returned {len(shadow)} graphs for {len(live)} "
+            "live answers"
+        )
+    worst: Optional[Dict[str, Any]] = None
+    for live_heads, shadow_heads in zip(live, shadow):
+        verdict = tolerance_report(shadow_heads, live_heads, bound, names=names)
+        if worst is None or verdict["fwd_err"] > worst["fwd_err"]:
+            worst = verdict
+    assert worst is not None  # len(live) >= 1: engines reject empty calls
+    worst["graphs"] = len(live)
+    return worst
+
+
+class ShadowGate:
+    """Locked shadow-comparison record; green == promotion-safe."""
+
+    def __init__(self, tolerance: float, min_samples: int = 8):
+        if not (isinstance(tolerance, (int, float)) and tolerance > 0):
+            raise ValueError(
+                f"shadow gate needs a positive tolerance bound, got "
+                f"{tolerance!r} (the bit-exactness contract is relaxed by "
+                "an explicit bound, never silently)"
+            )
+        if min_samples < 1:
+            raise ValueError(
+                f"shadow gate min_samples must be >= 1, got {min_samples}"
+            )
+        self.tolerance = float(tolerance)
+        self.min_samples = int(min_samples)
+        self._lock = tsan.instrument_lock(threading.Lock(), "ShadowGate._lock")
+        # Written by the shadow worker thread + router caller threads, read
+        # by promotion checks and /metrics scrapes.
+        self.mirrored_total = 0  # guarded-by: self._lock
+        self.compared_total = 0  # guarded-by: self._lock
+        self.failures_total = 0  # guarded-by: self._lock
+        self.errors_total = 0  # guarded-by: self._lock
+        self.dropped_total = 0  # guarded-by: self._lock
+        self.diff_max = 0.0  # guarded-by: self._lock
+        self._last_error: Optional[str] = None  # guarded-by: self._lock
+        self._candidate_versions: set = set()  # guarded-by: self._lock
+
+    # ------------------------------------------------------------- recorders
+    def count_mirrored(self) -> None:
+        with self._lock:
+            self.mirrored_total += 1
+
+    def count_dropped(self) -> None:
+        with self._lock:
+            self.dropped_total += 1
+
+    def count_error(self, error: str) -> None:
+        with self._lock:
+            self.errors_total += 1
+            self._last_error = error
+
+    def record(
+        self, verdict: Dict[str, Any], candidate_version: Optional[str] = None
+    ) -> None:
+        """Fold one :func:`compare_outputs` verdict into the gate."""
+        with self._lock:
+            self.compared_total += 1
+            self.diff_max = max(self.diff_max, float(verdict.get("fwd_err", 0.0)))
+            if not verdict.get("ok"):
+                self.failures_total += 1
+            if candidate_version:
+                self._candidate_versions.add(str(candidate_version))
+
+    # -------------------------------------------------------------- reporters
+    def report(self) -> Dict[str, Any]:
+        """Locked gate snapshot. ``green`` is the promotion predicate:
+        enough comparisons, zero failures. Errors (shadow replica down) and
+        drops don't fail the gate outright but do starve it of comparisons
+        — a gate that never saw its quota stays red."""
+        with self._lock:
+            compared = self.compared_total
+            failures = self.failures_total
+            out = {
+                "tolerance": self.tolerance,
+                "min_samples": self.min_samples,
+                "mirrored": self.mirrored_total,
+                "compared": compared,
+                "failures": failures,
+                "errors": self.errors_total,
+                "dropped": self.dropped_total,
+                "diff_max": self.diff_max,
+                "last_error": self._last_error,
+                "candidate_versions": sorted(self._candidate_versions),
+            }
+        out["green"] = compared >= self.min_samples and failures == 0
+        return out
+
+    def render_prometheus(self) -> str:
+        """The ``hydragnn_swap_*`` exposition family (appended to the
+        router's /metrics payload while a shadow arm is configured)."""
+        p = "hydragnn_swap"
+        snap = self.report()
+        lines: List[str] = []
+        for name in ("mirrored", "compared", "failures", "errors", "dropped"):
+            lines.append(f"# TYPE {p}_shadow_{name}_total counter")
+            lines.append(f"{p}_shadow_{name}_total {snap[name]}")
+        lines.append(f"# TYPE {p}_shadow_diff_max gauge")
+        lines.append(f"{p}_shadow_diff_max {snap['diff_max']}")
+        lines.append(f"# TYPE {p}_shadow_tolerance_bound gauge")
+        lines.append(f"{p}_shadow_tolerance_bound {snap['tolerance']}")
+        lines.append(f"# TYPE {p}_shadow_gate_green gauge")
+        lines.append(f"{p}_shadow_gate_green {1 if snap['green'] else 0}")
+        return "\n".join(lines) + "\n"

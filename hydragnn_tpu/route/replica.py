@@ -41,7 +41,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..graphs.sample import GraphSample
-from ..serve.server import REQUEST_ID_HEADER
+from ..serve.server import MODEL_VERSION_HEADER, REQUEST_ID_HEADER
 
 
 class ReplicaError(RuntimeError):
@@ -86,6 +86,18 @@ class Replica:
         numerically identical to a direct ``InferenceEngine.predict``."""
         raise NotImplementedError
 
+    def predict_versioned(
+        self,
+        samples: Sequence[GraphSample],
+        timeout: float = 60.0,
+        request_id: Optional[str] = None,
+    ) -> Tuple[List[List[np.ndarray]], Optional[str]]:
+        """``(results, model_version)`` — the version tag the lifecycle
+        layer threads through RouteResult and the response header
+        (docs/SERVING.md "Live model lifecycle"). Backends that cannot
+        report a version return None; both shipped backends can."""
+        return self.predict(samples, timeout=timeout, request_id=request_id), None
+
     def health(self) -> Dict[str, Any]:
         """The replica's /healthz view (ok, degraded, queue depth, compiled
         buckets, fault counters, hydration counters). Raising == down."""
@@ -108,6 +120,16 @@ class InProcessReplica(Replica):
         timeout: float = 60.0,
         request_id: Optional[str] = None,
     ) -> List[List[np.ndarray]]:
+        return self.predict_versioned(
+            samples, timeout=timeout, request_id=request_id
+        )[0]
+
+    def predict_versioned(
+        self,
+        samples: Sequence[GraphSample],
+        timeout: float = 60.0,
+        request_id: Optional[str] = None,
+    ) -> Tuple[List[List[np.ndarray]], Optional[str]]:
         from ..serve.engine import (
             BackpressureError,
             EngineClosedError,
@@ -115,7 +137,7 @@ class InProcessReplica(Replica):
         )
 
         try:
-            return self.engine.predict(
+            results, versions = self.engine.predict_versioned(
                 samples, timeout=timeout, request_id=request_id
             )
         except BackpressureError as e:
@@ -128,6 +150,8 @@ class InProcessReplica(Replica):
             raise ReplicaDownError(
                 f"replica {self.name}: {e}"
             ) from e
+        tagged = [v for v in versions if v]
+        return results, tagged[-1] if tagged else None
 
     def health(self) -> Dict[str, Any]:
         engine = self.engine
@@ -137,6 +161,8 @@ class InProcessReplica(Replica):
             "engine_restarts_total",
             "exec_cache_hydrated_total",
             "cache_misses_total",
+            "weight_swaps_total",
+            "swap_rejected_total",
         )
         # Mirrors the HTTP /healthz payload (serve/server.py) so the router
         # consumes ONE schema regardless of backend.
@@ -148,6 +174,9 @@ class InProcessReplica(Replica):
             "queue_limit": engine.queue_limit,
             "compiled_buckets": engine.compiled_buckets,
             "precision": engine.precision,
+            "model_version": engine.model_version,
+            "weight_swaps": counters["weight_swaps_total"],
+            "swaps_rejected": counters["swap_rejected_total"],
             "bad_batches": counters["bad_batches_total"],
             "nonfinite_outputs": counters["nonfinite_total"],
             "restarts": counters["engine_restarts_total"],
@@ -211,6 +240,16 @@ class HttpReplica(Replica):
         timeout: float = 60.0,
         request_id: Optional[str] = None,
     ) -> List[List[np.ndarray]]:
+        return self.predict_versioned(
+            samples, timeout=timeout, request_id=request_id
+        )[0]
+
+    def predict_versioned(
+        self,
+        samples: Sequence[GraphSample],
+        timeout: float = 60.0,
+        request_id: Optional[str] = None,
+    ) -> Tuple[List[List[np.ndarray]], Optional[str]]:
         body = json.dumps(
             {"graphs": [graph_doc(s) for s in samples]}
         ).encode()
@@ -220,9 +259,14 @@ class HttpReplica(Replica):
         req = urllib.request.Request(
             self.base_url + "/predict", data=body, headers=headers
         )
+        version: Optional[str] = None
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 doc = self._read_json(resp)
+                version = (
+                    doc.get("model_version")
+                    or resp.headers.get(MODEL_VERSION_HEADER)
+                )
         except urllib.error.HTTPError as e:
             payload = self._read_json(e)
             if e.code == 429:
@@ -255,7 +299,7 @@ class HttpReplica(Replica):
         return [
             [np.asarray(h, dtype=np.float32) for h in per_graph]
             for per_graph in doc["predictions"]
-        ]
+        ], version
 
     def health(self) -> Dict[str, Any]:
         try:

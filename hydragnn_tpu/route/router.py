@@ -44,12 +44,14 @@ stays on each engine's own sanctioned dispatch thread.
 from __future__ import annotations
 
 import math
+import queue
 import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..analysis import tsan
+from ..lifecycle.shadow import ShadowGate, compare_outputs
 from ..telemetry import graftel as telemetry
 from .admission import (
     AdmissionClass,
@@ -77,14 +79,21 @@ class RouteResult:
     (which replicas were tried, in order, with outcomes) — the response's
     routing provenance (docs/OBSERVABILITY.md "Serve request correlation")."""
 
-    __slots__ = ("results", "request_id", "replica", "hops", "klass")
+    __slots__ = (
+        "results", "request_id", "replica", "hops", "klass", "model_version"
+    )
 
-    def __init__(self, results, request_id, replica, hops, klass):
+    def __init__(
+        self, results, request_id, replica, hops, klass, model_version=None
+    ):
         self.results = results
         self.request_id = request_id
         self.replica = replica
         self.hops = hops
         self.klass = klass
+        # Which model version answered (docs/SERVING.md "Live model
+        # lifecycle") — surfaced as X-HydraGNN-Model-Version by the front.
+        self.model_version = model_version
 
 
 class _ReplicaEntry:
@@ -204,6 +213,16 @@ class Router:
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
         self._health_ctx: Optional[Any] = None
+        # Shadow map (graftswap, docs/SERVING.md "Live model lifecycle"):
+        # one optional {replica, fraction, gate} record. Written by
+        # set_shadow/clear_shadow (operator threads), read by every caller
+        # thread's mirror decision and the shadow worker. Mirrored work
+        # rides a bounded self-sync queue so a slow candidate can never
+        # block live traffic (full queue -> dropped, counted on the gate).
+        self._shadow: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        self._shadow_queue: "queue.Queue" = queue.Queue(maxsize=64)
+        self._shadow_thread: Optional[threading.Thread] = None
+        self._shadow_ctx: Optional[Any] = None
         for item in replicas:
             if isinstance(item, tuple):
                 self.add_replica(item[0], weight=item[1])
@@ -297,6 +316,146 @@ class Router:
             wall_s=round(time.perf_counter() - t0, 4),
         )
 
+    # ------------------------------------------------------------ shadow arm
+    def set_shadow(
+        self,
+        replica: Replica,
+        fraction: float,
+        tolerance: float,
+        min_samples: int = 8,
+    ) -> ShadowGate:
+        """Arm shadow mode: mirror a sampled ``fraction`` of successful live
+        calls to ``replica`` (a candidate-version replica NOT in the ring)
+        and feed the tolerance-gated diff gate (lifecycle/shadow.py;
+        ``hydragnn_swap_*`` metrics). Shadow answers are never returned to
+        callers and never counted against SLO admission. The same knobs are
+        statically checked as ``bad-lifecycle`` findings
+        (analysis/contracts.py): fraction must be in (0, 1], tolerance
+        positive."""
+        fraction = float(fraction)
+        if not (0.0 < fraction <= 1.0) or not math.isfinite(fraction):
+            raise ValueError(
+                f"shadow fraction must be in (0, 1], got {fraction!r}"
+            )
+        gate = ShadowGate(tolerance=tolerance, min_samples=min_samples)
+        with self._lock:
+            self._shadow = {
+                "replica": replica,
+                "fraction": fraction,
+                "gate": gate,
+            }
+        self._start_shadow_worker()
+        self.metrics.set_replica_state(replica.name, "shadow")
+        telemetry.event(
+            "swap/shadow_armed",
+            replica=replica.name,
+            fraction=fraction,
+            tolerance=float(tolerance),
+        )
+        return gate
+
+    def clear_shadow(self) -> None:
+        """Disarm shadow mode (the gate record stays readable via the
+        returned handle; promotion already consumed it)."""
+        with self._lock:
+            shadow = self._shadow
+            self._shadow = None
+        if shadow is not None:
+            self.metrics.set_replica_state(shadow["replica"].name, None)
+            telemetry.event(
+                "swap/shadow_cleared", replica=shadow["replica"].name
+            )
+
+    def shadow_report(self) -> Dict[str, Any]:
+        """The shadow gate's snapshot + arm config ({configured: False}
+        when no shadow is armed) — what LifecycleManager.promote gates on
+        and the router /healthz exposes."""
+        with self._lock:
+            shadow = self._shadow
+        if shadow is None:
+            return {"configured": False, "green": False}
+        report = shadow["gate"].report()
+        report.update(
+            configured=True,
+            replica=shadow["replica"].name,
+            fraction=shadow["fraction"],
+        )
+        return report
+
+    def shadow_prometheus(self) -> str:
+        """``hydragnn_swap_*`` exposition ('' when no shadow is armed) —
+        appended to the router /metrics payload."""
+        with self._lock:
+            shadow = self._shadow
+        return shadow["gate"].render_prometheus() if shadow else ""
+
+    def _start_shadow_worker(self) -> None:
+        if self._shadow_thread is not None and self._shadow_thread.is_alive():
+            return
+        self._shadow_ctx = telemetry.new_context()
+        self._shadow_thread = threading.Thread(
+            target=self._shadow_loop,
+            name="hydragnn-route-shadow",
+            daemon=True,
+        )
+        self._shadow_thread.start()
+
+    def _maybe_shadow(self, samples, results, rid: str) -> None:
+        """Caller-thread mirror decision: sampled, non-blocking, invisible
+        to the caller. A full mirror queue drops (counted) — live latency
+        is never a function of candidate health."""
+        with self._lock:
+            shadow = self._shadow
+        if shadow is None:
+            return
+        if self._rng.random() >= shadow["fraction"]:
+            return
+        gate: ShadowGate = shadow["gate"]
+        gate.count_mirrored()
+        try:
+            self._shadow_queue.put_nowait((shadow, samples, results, rid))
+        except queue.Full:
+            gate.count_dropped()
+            telemetry.event("swap/shadow_dropped", request_id=rid)
+
+    def _shadow_loop(self) -> None:
+        telemetry.attach(self._shadow_ctx)
+        while not self._stop.is_set():
+            try:
+                shadow, samples, live, rid = self._shadow_queue.get(
+                    timeout=0.2
+                )
+            except queue.Empty:
+                continue
+            gate: ShadowGate = shadow["gate"]
+            replica: Replica = shadow["replica"]
+            try:
+                with telemetry.span(
+                    "swap/shadow_dispatch",
+                    request_id=rid,
+                    replica=replica.name,
+                ):
+                    mirrored, version = replica.predict_versioned(
+                        samples,
+                        timeout=self.default_timeout_s,
+                        request_id=f"{rid}/shadow",
+                    )
+                verdict = compare_outputs(live, mirrored, gate.tolerance)
+            except Exception as e:  # noqa: BLE001 — gate-scoped, never live
+                gate.count_error(repr(e))
+                telemetry.event(
+                    "swap/shadow_error", request_id=rid, error=repr(e)
+                )
+                continue
+            gate.record(verdict, candidate_version=version)
+            telemetry.event(
+                "swap/shadow_diff",
+                request_id=rid,
+                ok=bool(verdict["ok"]),
+                fwd_err=verdict["fwd_err"],
+                candidate_version=version,
+            )
+
     def remove_replica(self, name: str) -> Optional[Replica]:
         """Drop a replica from the table entirely (the caller closes it)."""
         with self._lock:
@@ -322,6 +481,8 @@ class Router:
         self._stop.set()
         if self._health_thread is not None:
             self._health_thread.join(timeout)
+        if self._shadow_thread is not None:
+            self._shadow_thread.join(timeout)
         if close_replicas:
             with self._lock:
                 replicas = [
@@ -439,11 +600,23 @@ class Router:
                     klass=klass,
                     hop=len(hops),
                 ):
-                    results = replica.predict(
-                        samples,
-                        timeout=min(remaining, hop_timeout),
-                        request_id=rid,
-                    )
+                    # Versioned dispatch when the backend supports it (both
+                    # shipped backends do); plain Replica duck-types keep
+                    # working with an untagged response.
+                    versioned = getattr(replica, "predict_versioned", None)
+                    if versioned is not None:
+                        results, model_version = versioned(
+                            samples,
+                            timeout=min(remaining, hop_timeout),
+                            request_id=rid,
+                        )
+                    else:
+                        results = replica.predict(
+                            samples,
+                            timeout=min(remaining, hop_timeout),
+                            request_id=rid,
+                        )
+                        model_version = None
             except ReplicaBackpressureError as e:
                 self._release(name, ok=True)
                 hops.append(self._hop(name, "backpressure", t_hop, spilled))
@@ -486,9 +659,15 @@ class Router:
                 request_id=rid,
                 replica=name,
                 hops=len(hops),
+                model_version=model_version,
                 e2e_s=round(e2e, 6),
             )
-            return RouteResult(results, rid, name, hops, klass)
+            # Shadow mirror AFTER the live answer is final: the candidate
+            # sees real traffic, the caller never sees the candidate.
+            self._maybe_shadow(samples, results, rid)
+            return RouteResult(
+                results, rid, name, hops, klass, model_version=model_version
+            )
 
         # Candidates exhausted (or deadline passed) without a result.
         depth = self.queue_depth()

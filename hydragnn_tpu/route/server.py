@@ -62,6 +62,9 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
                     "admitted": admitted,
                     "replicas": states,
                     "queue_depth": router.queue_depth(),
+                    # Shadow/canary arm status (docs/SERVING.md "Live model
+                    # lifecycle"): the diff-gate record promotion gates on.
+                    "shadow": router.shadow_report(),
                     "classes": {
                         name: {"deadline_s": c.deadline_s, "priority": c.priority}
                         for name, c in sorted(router.classes.items())
@@ -71,7 +74,9 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send_text(
                 200,
-                self.router.metrics.render_prometheus() + render_prometheus(),
+                self.router.metrics.render_prometheus()
+                + self.router.shadow_prometheus()
+                + render_prometheus(),
                 "text/plain; version=0.0.4",
             )
         else:
@@ -149,12 +154,16 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
             self._send_json(503, {"error": str(e), "request_id": rid})
             return
 
+        # The answering replica's model version rides the same echo contract
+        # as the request id (RequestPlumbing._model_version override).
+        self._mv_override = res.model_version
         self._send_json(
             200,
             {
                 "request_id": res.request_id,
                 "replica": res.replica,
                 "class": res.klass,
+                "model_version": res.model_version,
                 "hops": res.hops,
                 "predictions": [
                     [np.asarray(h).tolist() for h in per_graph]

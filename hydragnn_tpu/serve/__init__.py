@@ -13,6 +13,7 @@ from .engine import (
     InferenceEngine,
     NonFiniteOutputError,
     PrecisionToleranceError,
+    SwapFingerprintError,
 )
 from .metrics import LatencyHistogram, ServeMetrics
 from .server import InferenceServer, parse_graph
@@ -27,5 +28,6 @@ __all__ = [
     "NonFiniteOutputError",
     "PrecisionToleranceError",
     "ServeMetrics",
+    "SwapFingerprintError",
     "parse_graph",
 ]

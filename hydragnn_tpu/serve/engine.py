@@ -97,6 +97,14 @@ class PrecisionToleranceError(RuntimeError):
         self.report = report
 
 
+class SwapFingerprintError(RuntimeError):
+    """:meth:`InferenceEngine.swap_weights` rejected the incoming variables:
+    their param-tree fingerprint (key paths/shapes/dtypes) does not match the
+    tree the engine's executables were compiled against. The engine keeps
+    serving its CURRENT weights — a wrong-architecture swap must never take
+    the tier down (docs/SERVING.md "Live model lifecycle")."""
+
+
 class _Future:
     """Minimal thread-safe future.
 
@@ -109,7 +117,7 @@ class _Future:
     ``concurrent.futures.TimeoutError`` is not the builtin ``TimeoutError``
     callers naturally catch.)"""
 
-    __slots__ = ("_event", "_result", "_error", "request_id")
+    __slots__ = ("_event", "_result", "_error", "request_id", "model_version")
 
     def __init__(self, request_id: Optional[str] = None):
         self._event = threading.Event()
@@ -118,6 +126,10 @@ class _Future:
         # Correlation id (docs/OBSERVABILITY.md): assigned at submit, echoed
         # by the HTTP layer as X-HydraGNN-Request-Id.
         self.request_id = request_id
+        # Model version the resolving batch executed against (set before
+        # set_result; the lifecycle layer's per-response version tag —
+        # docs/SERVING.md "Live model lifecycle").
+        self.model_version: Optional[str] = None
 
     def set_result(self, value) -> None:
         self._result = value
@@ -231,6 +243,13 @@ class InferenceEngine:
         whole ladder from disk. ``None`` falls back to the
         ``HYDRAGNN_COMPILE_CACHE`` env var; empty/unset disables
         persistence (the historical in-memory-only cache).
+    model_version:
+        The version tag of the weights the engine boots with
+        (docs/SERVING.md "Live model lifecycle"): tagged on every
+        response (``fut.model_version``, the ``X-HydraGNN-Model-Version``
+        header) and /healthz, and replaced atomically by
+        :meth:`swap_weights`. ``from_config`` derives it from the
+        checkpoint's verified content identity.
     autostart:
         Tests set False to exercise queue behavior without worker threads;
         call :meth:`start` to launch them later.
@@ -256,6 +275,7 @@ class InferenceEngine:
         compile_cache: Optional[str] = None,
         precision: str = "f32",
         tolerance: Optional[float] = None,
+        model_version: str = "v0",
         autostart: bool = True,
     ):
         import jax
@@ -273,9 +293,13 @@ class InferenceEngine:
             )
         self.precision = precision
         self.tolerance = None if tolerance is None else float(tolerance)
-        self._quant_report: Optional[Dict[str, Any]] = None
-        self._ref_model = None
-        self._ref_variables: Optional[Dict[str, Any]] = None
+        # Quantized-arm reference state: rebound only under _swap_lock
+        # (created below; __init__ is pre-publication) — a swap and a
+        # concurrent tolerance check must agree on which f32 reference
+        # belongs to the published weights.
+        self._quant_report: Optional[Dict[str, Any]] = None  # guarded-by: self._swap_lock
+        self._ref_model = None  # guarded-by: self._swap_lock, dirty-reads(bound once in __init__, never rebound — swaps replace the reference VARIABLES, not the f32 module clone)
+        self._ref_variables: Optional[Dict[str, Any]] = None  # guarded-by: self._swap_lock
         if precision != "f32":
             if self.tolerance is None or self.tolerance <= 0:
                 raise ValueError(
@@ -326,8 +350,8 @@ class InferenceEngine:
         self._packing = bool(packing)
         self._ladder_step = ladder_step
 
-        self._params = jax.device_put(variables["params"])
-        self._bstats = jax.device_put(variables.get("batch_stats", {}))
+        params = jax.device_put(variables["params"])
+        bstats = jax.device_put(variables.get("batch_stats", {}))
         self._jit = jax.jit(
             lambda params, bstats, batch: _apply_model(
                 model, params, bstats, batch, train=False
@@ -335,6 +359,29 @@ class InferenceEngine:
         )
         self._lock = tsan.instrument_lock(
             threading.Lock(), "InferenceEngine._lock"
+        )
+        # Serializes whole swaps (validate → quantize → gate → publish):
+        # two concurrent swap_weights calls must publish in a total order,
+        # and the quantized-arm reference state above must always describe
+        # the published weights. Never held by the dispatch/feed threads —
+        # request traffic only ever takes _lock. Lock order: _swap_lock
+        # before _lock (the publish inside a swap).
+        self._swap_lock = tsan.instrument_lock(
+            threading.Lock(), "InferenceEngine._swap_lock"
+        )
+        # THE atomic weight reference (docs/SERVING.md "Live model
+        # lifecycle"): (params, batch_stats, model_version) published as ONE
+        # tuple — the dispatch thread reads it once per batch, so every
+        # in-flight batch executes entirely against one version and every
+        # response is tagged with exactly the version that produced it.
+        # swap_weights() rebinds it under the lock; the compiled executables
+        # take params/batch_stats as ARGUMENTS (and CacheKey fingerprints the
+        # param TREE, not the values), so a same-architecture swap reuses
+        # every compiled bucket with zero recompiles.
+        self._weights: Tuple[Any, Any, str] = (  # guarded-by: self._lock
+            params,
+            bstats,
+            str(model_version),
         )
         # Compiled-executable cache: filled by warmup() on the caller thread
         # AND by cache misses on the dispatch thread — since the graftcache
@@ -452,6 +499,19 @@ class InferenceEngine:
         callers must not reach through the registry's internals directly)."""
         return len(self._registry)
 
+    def _current_weights(self) -> Tuple[Any, Any, str]:
+        """One locked read of the atomic (params, batch_stats, version)
+        reference — the only way any consumer (dispatch, warmup, tolerance
+        gate, status surfaces) may observe the weights."""
+        with self._lock:
+            return self._weights
+
+    @property
+    def model_version(self) -> str:
+        """The version the engine currently answers with (tagged on every
+        response and /healthz — docs/SERVING.md "Live model lifecycle")."""
+        return self._current_weights()[2]
+
     @property
     def degraded(self) -> bool:
         """Sticky health downgrade: the engine is serving, but it has seen
@@ -565,10 +625,26 @@ class InferenceEngine:
         timeout: Optional[float] = 60.0,
         request_id: Optional[str] = None,
     ) -> List[List[np.ndarray]]:
-        """Synchronous convenience: submit all, wait all. Returns one
+        """Synchronous convenience: submit all, wait all (results only; see
+        :meth:`predict_versioned` for the per-graph model-version tags)."""
+        results, _versions = self.predict_versioned(
+            samples, timeout=timeout, request_id=request_id
+        )
+        return results
+
+    def predict_versioned(
+        self,
+        samples: Sequence[GraphSample],
+        timeout: Optional[float] = 60.0,
+        request_id: Optional[str] = None,
+    ) -> Tuple[List[List[np.ndarray]], List[Optional[str]]]:
+        """Submit all, wait all → ``(results, versions)`` where versions[i]
+        is the model version graph i's batch executed against. Returns one
         per-head output list per input graph. A multi-graph call shares one
         ``request_id`` base (the HTTP layer's correlation id); each graph
-        gets ``<request_id>/<i>``.
+        gets ``<request_id>/<i>``. Per-request version consistency: each
+        graph's version is exact; a multi-graph call racing a hot swap may
+        legitimately span the old and new versions across its graphs.
 
         All samples are validated BEFORE any is admitted (a malformed graph
         rejects the call without consuming device work), and a multi-graph
@@ -607,7 +683,8 @@ class InferenceEngine:
                 except Exception:
                     pass
             raise
-        return [f.result(timeout) for f in futures]
+        results = [f.result(timeout) for f in futures]
+        return results, [f.model_version for f in futures]
 
     def _validate(self, sample: GraphSample) -> None:
         # Overlaps structurally with the loader-side quarantine validator
@@ -812,12 +889,17 @@ class InferenceEngine:
         )
         return work, dev
 
-    def _cache_key(self, bucket: Tuple[int, int, int], batch) -> Optional[CacheKey]:
+    def _cache_key(
+        self, bucket: Tuple[int, int, int], batch, params, bstats
+    ) -> Optional[CacheKey]:
         """Persistent-store key for one bucket shape, or None when no store
         is bound (in-memory misses then skip the fingerprint arithmetic).
         The args digest covers the FULL call signature (params, batch_stats,
         batch) — host and device copies of a batch share shapes/dtypes, so
-        warmup (host dummy batch) and live traffic (device batch) agree."""
+        warmup (host dummy batch) and live traffic (device batch) agree —
+        and ``tree_signature`` hashes STRUCTURE, so a hot weight swap of the
+        same architecture keys identically (zero recompiles, zero
+        cross-architecture hits)."""
         if self._registry.store is None:
             return None
         return CacheKey.for_environment(
@@ -825,10 +907,10 @@ class InferenceEngine:
             config_fingerprint=self._config_fingerprint,
             flags=self._key_flags,
             bucket=bucket,
-            args_digest=tree_signature((self._params, self._bstats, batch)),
+            args_digest=tree_signature((params, bstats, batch)),
         )
 
-    def _executable_for(self, dev_batch):
+    def _executable_for(self, dev_batch, params, bstats):
         key = (
             dev_batch.num_nodes_pad,
             dev_batch.num_edges_pad,
@@ -842,8 +924,8 @@ class InferenceEngine:
         # param-tree fingerprint arithmetic.
         exe, outcome, seconds = self._registry.lookup_or_compile(
             key,
-            lambda: self._cache_key(key, dev_batch),
-            lambda: self._jit.lower(self._params, self._bstats, dev_batch),
+            lambda: self._cache_key(key, dev_batch, params, bstats),
+            lambda: self._jit.lower(params, bstats, dev_batch),
         )
         if outcome == "memory":
             self.metrics.count("cache_hits_total")
@@ -866,16 +948,21 @@ class InferenceEngine:
             allow=allow, action=action, label="serve steady state"
         )
 
-    def _execute(self, dev_batch) -> List[np.ndarray]:
-        """Run the (cached) compiled executable; host numpy outputs."""
+    def _execute(self, dev_batch) -> Tuple[List[np.ndarray], str]:
+        """Run the (cached) compiled executable; host numpy outputs plus the
+        model version the batch executed against. The weight reference is
+        read ONCE here, so the whole batch — and every response demuxed from
+        it — belongs to exactly one version even while a swap publishes a
+        new one concurrently."""
         import jax
 
-        exe = self._executable_for(dev_batch)
+        params, bstats, version = self._current_weights()
+        exe = self._executable_for(dev_batch, params, bstats)
         t0 = time.perf_counter()
-        outputs = exe(self._params, self._bstats, dev_batch)
+        outputs = exe(params, bstats, dev_batch)
         outputs = jax.block_until_ready(outputs)
         self.metrics.observe("device", time.perf_counter() - t0)
-        return [np.asarray(o) for o in outputs]
+        return [np.asarray(o) for o in outputs], version
 
     def _dispatch_loop(self) -> None:
         # Explicit context handoff: the dispatcher's device spans parent to
@@ -894,9 +981,9 @@ class InferenceEngine:
                     "serve/device",
                     request_ids=[r.request_id for r in work.requests],
                 ):
-                    outputs = self._execute(dev_batch)
+                    outputs, version = self._execute(dev_batch)
                 try:
-                    self._resolve(work, outputs)
+                    self._resolve(work, outputs, version)
                 except Exception as e:  # noqa: BLE001 — batch-scoped
                     for req in work.requests:
                         self._reject(req, e)
@@ -909,7 +996,9 @@ class InferenceEngine:
         except BaseException as e:  # noqa: BLE001 — re-raised at callers
             self._fail(e)
 
-    def _resolve(self, work: _BatchWork, outputs: List[np.ndarray]) -> None:
+    def _resolve(
+        self, work: _BatchWork, outputs: List[np.ndarray], version: str
+    ) -> None:
         now = time.perf_counter()
         batch_had_nonfinite = False
         for i, req in enumerate(work.requests):
@@ -941,6 +1030,9 @@ class InferenceEngine:
                 continue
             with self._lock:
                 self._pending.discard(req.future)
+            # Version tag BEFORE set_result: a waiter woken by the event
+            # must never observe a result without its version.
+            req.future.model_version = version
             req.future.set_result(per_head)
             self.metrics.observe("e2e", now - req.t_submit)
             # Demux complete: the end of the correlation trail
@@ -948,6 +1040,7 @@ class InferenceEngine:
             telemetry.event(
                 "serve/response",
                 request_id=req.request_id,
+                model_version=version,
                 e2e_s=round(now - req.t_submit, 6),
             )
         if batch_had_nonfinite:
@@ -1084,6 +1177,7 @@ class InferenceEngine:
                 set(self._ladder) | {(int(n), int(e)) for n, e in ladder}
             )
         compiled = 0
+        params, bstats, _version = self._current_weights()
         # Iterate the MERGED ladder: constructor-declared buckets still cold
         # at this point must warm too, as the docstring promises. With a
         # persistent store bound, a rung found on disk HYDRATES (seconds,
@@ -1096,8 +1190,8 @@ class InferenceEngine:
             batch = self._dummy_batch(int(n_pad), int(e_pad))
             _exe, outcome, seconds = self._registry.lookup_or_compile(
                 key,
-                self._cache_key(key, batch),
-                lambda b=batch: self._jit.lower(self._params, self._bstats, b),
+                self._cache_key(key, batch, params, bstats),
+                lambda b=batch: self._jit.lower(params, bstats, b),
             )
             if outcome == "disk":
                 self.metrics.record_hydrate(seconds)
@@ -1124,6 +1218,131 @@ class InferenceEngine:
             num_graphs_pad=self._g_pad,
             edge_dim=self._edge_dim,
         )
+
+    # ------------------------------------------------------ hot weight swap
+    def swap_weights(self, variables: Dict[str, Any], version: str) -> Dict[str, Any]:
+        """Atomic, per-request-consistent hot weight swap (docs/SERVING.md
+        "Live model lifecycle"; ROADMAP item 4).
+
+        Validates the incoming param-tree fingerprint against the tree the
+        compiled executables take as arguments — a mismatch raises
+        :class:`SwapFingerprintError` and the engine KEEPS SERVING its
+        current weights. On a match, the new ``(params, batch_stats,
+        version)`` triple is published as one reference under the engine
+        lock: every in-flight batch executes entirely against one version
+        (the dispatch thread reads the reference once per batch), versions
+        observed by responses are monotonic, and — because ``CacheKey`` /
+        ``tree_signature`` fingerprint the param TREE, not the values —
+        every compiled bucket is reused with ZERO recompiles.
+
+        Quantized arms (``precision != 'f32'``) re-apply their transform to
+        the incoming f32 variables (int8 re-snaps the weight grid) and
+        RE-RUN the PR-11 tolerance gate on the CANDIDATE weights before they
+        publish; a gate failure raises :class:`PrecisionToleranceError` with
+        the engine untouched — a candidate that cannot meet the declared
+        bound never serves a single request. On success the new f32
+        reference is retained for future gates.
+
+        Returns a small report: {version, previous_version, wall_s, gate}.
+        """
+        import jax
+
+        from ..checkpoint.format import param_fingerprint
+        from ..precision import fake_quantize_params
+
+        if self._error is not None:
+            raise EngineFailedError(
+                "inference worker died; engine must be rebuilt"
+            ) from self._error
+        if self._closing.is_set():
+            raise EngineClosedError("engine is shut down")
+        t0 = time.perf_counter()
+        # Whole-swap mutex: concurrent swaps (a promote racing a rollback)
+        # must validate against, gate against, and replace the SAME
+        # predecessor in a total order — and the quantized-arm reference
+        # state must always describe the published weights.
+        with self._swap_lock:
+            old_params, old_bstats, old_version = self._current_weights()
+            want = param_fingerprint(old_params) + param_fingerprint(
+                old_bstats
+            )
+            got = param_fingerprint(variables["params"]) + param_fingerprint(
+                variables.get("batch_stats", {})
+            )
+            if got != want:
+                self.metrics.count("swap_rejected_total")
+                telemetry.event(
+                    "serve/swap_rejected",
+                    version=str(version),
+                    reason="param-tree fingerprint mismatch",
+                )
+                raise SwapFingerprintError(
+                    f"swap to version {version!r} rejected: its param-tree "
+                    "fingerprint does not match the serving architecture — "
+                    "the engine keeps serving version "
+                    f"{old_version!r} (rebuild the engine for an "
+                    "architecture change; a hot swap is weights-only)"
+                )
+            serve_params = variables["params"]
+            quant_report = None
+            if self.precision == "int8":
+                serve_params, quant_report = fake_quantize_params(
+                    serve_params
+                )
+            params = jax.device_put(serve_params)
+            bstats = jax.device_put(variables.get("batch_stats", {}))
+            jax.block_until_ready((params, bstats))
+            gate_report = None
+            if self.precision != "f32":
+                # The tolerance gate runs on the CANDIDATE weights BEFORE
+                # they publish: a candidate that cannot meet its declared
+                # bound must never serve a single live request (and response
+                # versions stay monotonic — no publish-then-revert flicker).
+                try:
+                    gate_report = self._tolerance_gate(
+                        params, bstats, variables, quant_report
+                    )
+                except PrecisionToleranceError:
+                    self.metrics.count("swap_gate_failures_total")
+                    telemetry.event(
+                        "serve/swap_gate_failed", version=str(version)
+                    )
+                    raise
+            # Annotated interleaving site: the publish races the dispatch
+            # thread's per-batch read — the tsan swap drill perturbs exactly
+            # this window (benchmarks/tsan_drill.py _swap_drill).
+            tsan.yield_point("serve.swap.pre_publish")
+            with self._lock:
+                self._weights = (params, bstats, str(version))
+            if self.precision != "f32":
+                self._ref_variables = variables
+                if quant_report is not None:
+                    self._quant_report = quant_report
+        wall = time.perf_counter() - t0
+        self.metrics.count("weight_swaps_total")
+        telemetry.event(
+            "serve/weights_swapped",
+            version=str(version),
+            previous_version=old_version,
+            wall_s=round(wall, 4),
+        )
+        return {
+            "version": str(version),
+            "previous_version": old_version,
+            "wall_s": round(wall, 4),
+            "gate": gate_report,
+        }
+
+    def restore_weights(self, weights: Tuple[Any, Any, str]) -> None:
+        """Republish a triple previously read from :meth:`_current_weights`
+        — the manager's mid-fleet unwind (a swap that failed on replica k
+        must not leave replicas 0..k-1 serving a version the registry never
+        promoted). No fingerprint or gate re-run: the triple already served
+        on this engine."""
+        with self._swap_lock:
+            with self._lock:
+                self._weights = weights
+        telemetry.event("serve/weights_restored", version=weights[2])
 
     # ------------------------------------------------------- tolerance gate
     def _calibration_samples(
@@ -1170,17 +1389,37 @@ class InferenceEngine:
         ``precision="f32"`` returns a trivial verdict: the f32 contract is
         bit-exactness against ``run_prediction`` (tests/test_serve_engine.py),
         not a tolerance."""
-        import jax
-
-        from ..precision import tolerance_report
-        from ..train.trainer import _apply_model
-
         if self.precision == "f32":
             return {
                 "ok": True,
                 "arm": "f32",
                 "note": "bit-exactness contract — no tolerance gate",
             }
+        # Consistent (weights, reference) pair: a swap completing after this
+        # read yields a stale-but-self-consistent verdict, never a mixed one.
+        with self._swap_lock:
+            params, bstats, _version = self._current_weights()
+            ref_vars = self._ref_variables
+            quant_report = self._quant_report
+        return self._tolerance_gate(params, bstats, ref_vars, quant_report, samples)
+
+    def _tolerance_gate(
+        self,
+        params,
+        bstats,
+        ref_vars,
+        quant_report,
+        samples: Optional[Sequence[GraphSample]] = None,
+    ):
+        """The gate body over EXPLICIT weights + reference: shared by
+        :meth:`check_tolerance` (the live weights) and :meth:`swap_weights`
+        (candidate weights BEFORE they publish — a failing candidate must
+        never serve a single live request)."""
+        import jax
+
+        from ..precision import tolerance_report
+        from ..train.trainer import _apply_model
+
         if samples is None:
             samples = self._calibration_samples()
         else:
@@ -1209,12 +1448,9 @@ class InferenceEngine:
         dev = jax.device_put(batch)
         quant = [
             np.asarray(o)
-            for o in jax.block_until_ready(
-                self._jit(self._params, self._bstats, dev)
-            )
+            for o in jax.block_until_ready(self._jit(params, bstats, dev))
         ]
         ref_model = self._ref_model
-        ref_vars = self._ref_variables
         assert ref_model is not None and ref_vars is not None
         ref_fn = jax.jit(
             lambda p, b, x: _apply_model(ref_model, p, b, x, train=False)
@@ -1232,8 +1468,8 @@ class InferenceEngine:
         )
         report["arm"] = self.precision
         report["probe_graphs"] = len(samples)
-        if self._quant_report is not None:
-            report["quantization"] = self._quant_report
+        if quant_report is not None:
+            report["quantization"] = quant_report
         self.metrics.record_precision_gate(report)
         telemetry.event(
             "serve/precision_gate",
@@ -1341,6 +1577,28 @@ class InferenceEngine:
         options.setdefault("head_names", voi.get("output_names"))
         if voi.get("denormalize_output") and voi.get("y_minmax"):
             options.setdefault("y_minmax", voi["y_minmax"])
+        if "model_version" not in options:
+            # The lifecycle layer's per-response version tag defaults to the
+            # checkpoint's verified content identity (short form) so a
+            # config-booted replica reports the same version id a
+            # ModelRegistry would assign. v1/torch checkpoints carry no
+            # verifiable identity — labeled, never guessed.
+            if fmt == "native":
+                path_name = checkpoint or os.path.join(
+                    logs_path,
+                    get_log_name_config(config),
+                    get_log_name_config(config) + ".pk",
+                )
+                try:
+                    from ..checkpoint.format import file_content_identity
+
+                    options["model_version"] = file_content_identity(
+                        path_name
+                    )[0][:12]
+                except Exception:  # noqa: BLE001 — v1 pickle, fallback load
+                    options["model_version"] = "unverified"
+            else:
+                options["model_version"] = "torch-import"
         return cls(model, variables, **options)
 
     @staticmethod

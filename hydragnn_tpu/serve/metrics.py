@@ -164,6 +164,12 @@ class ServeMetrics:
         self.bad_batches_total = 0  # guarded-by: self._lock
         self.nonfinite_total = 0  # guarded-by: self._lock
         self.engine_restarts_total = 0  # guarded-by: self._lock
+        # Live model lifecycle (graftswap, docs/SERVING.md): completed hot
+        # weight swaps, fingerprint-rejected swap attempts, and post-swap
+        # tolerance-gate reverts on quantized arms.
+        self.weight_swaps_total = 0  # guarded-by: self._lock
+        self.swap_rejected_total = 0  # guarded-by: self._lock
+        self.swap_gate_failures_total = 0  # guarded-by: self._lock
         self.batches_total = 0  # guarded-by: self._lock
         self.graphs_total = 0  # guarded-by: self._lock
         self.cache_hits_total = 0  # guarded-by: self._lock
@@ -295,6 +301,9 @@ class ServeMetrics:
                 "bad_batches_total": self.bad_batches_total,
                 "nonfinite_total": self.nonfinite_total,
                 "engine_restarts_total": self.engine_restarts_total,
+                "weight_swaps_total": self.weight_swaps_total,
+                "swap_rejected_total": self.swap_rejected_total,
+                "swap_gate_failures_total": self.swap_gate_failures_total,
                 "batches_total": batches,
                 "graphs_total": self.graphs_total,
                 "bucket_cache": {
@@ -371,6 +380,10 @@ class ServeMetrics:
         ("bad_batches_total", "bad_batches_total"),
         ("nonfinite_total", "nonfinite_total"),
         ("engine_restarts_total", "engine_restarts_total"),
+        # Hot-swap lifecycle counters (docs/OBSERVABILITY.md catalogue).
+        ("weight_swaps_total", "weight_swaps_total"),
+        ("swap_rejected_total", "swap_rejected_total"),
+        ("swap_gate_failures_total", "swap_gate_failures_total"),
         ("batches_total", "batches_total"),
         ("graphs_total", "graphs_total"),
         ("cache_hits_total", "bucket_cache_hits_total"),

@@ -33,6 +33,11 @@ REQUEST_ID_HEADER = "X-HydraGNN-Request-Id"
 # process running as one replica of a routed fleet labels every response so
 # the router's hop logs and clients can attribute answers to replicas.
 REPLICA_ID_HEADER = "X-HydraGNN-Replica"
+# Live model lifecycle (docs/SERVING.md "Live model lifecycle"): every
+# response names the model version that answered it — echoed on ALL paths
+# like the request-id header, so a client (and the swap-under-load drill)
+# can assert no response is ever version-torn across a hot swap.
+MODEL_VERSION_HEADER = "X-HydraGNN-Model-Version"
 
 
 def parse_graph(doc: dict) -> GraphSample:
@@ -104,7 +109,22 @@ class RequestPlumbing:
             and all(c in self._RID_SAFE for c in raw)
         )
         self._rid = raw if ok else telemetry.new_request_id()
+        # Per-request model-version override (the router front end sets it
+        # from the answering replica's RouteResult); handler instances
+        # persist across keep-alive requests, so it must reset here.
+        self._mv_override: Optional[str] = None
         return self._rid
+
+    def _model_version(self) -> Optional[str]:
+        """The model version this response reports: a per-request override
+        (router path — whatever replica answered) or the server-wide
+        provider (engine path — the engine's CURRENT version, which is the
+        honest answer on non-predict paths like /healthz and 4xx)."""
+        override = getattr(self, "_mv_override", None)
+        if override:
+            return override
+        fn = getattr(self.server, "model_version_fn", None)  # type: ignore[attr-defined]
+        return fn() if fn is not None else None
 
     def _send_json(self, code: int, payload: dict, headers: Optional[dict] = None):
         body = json.dumps(payload).encode()
@@ -115,6 +135,9 @@ class RequestPlumbing:
         replica_id = getattr(self.server, "replica_id", None)
         if replica_id:
             self.send_header(REPLICA_ID_HEADER, replica_id)
+        model_version = self._model_version()
+        if model_version:
+            self.send_header(MODEL_VERSION_HEADER, model_version)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -129,6 +152,9 @@ class RequestPlumbing:
         replica_id = getattr(self.server, "replica_id", None)
         if replica_id:
             self.send_header(REPLICA_ID_HEADER, replica_id)
+        model_version = self._model_version()
+        if model_version:
+            self.send_header(MODEL_VERSION_HEADER, model_version)
         self.end_headers()
         self.wfile.write(body)
 
@@ -158,6 +184,11 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
                 # persistent store vs fresh compiles.
                 "exec_cache_hydrated_total",
                 "cache_misses_total",
+                # Lifecycle (docs/SERVING.md "Live model lifecycle"): the
+                # router's health map learns which version each replica
+                # runs and whether swaps happened/were refused.
+                "weight_swaps_total",
+                "swap_rejected_total",
             )
             self._send_json(
                 200 if engine.running else 503,
@@ -175,6 +206,12 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
                     # a glance whether this replica answers under the
                     # bit-exactness contract or a tolerance gate.
                     "precision": engine.precision,
+                    # Which model version this replica answers with — the
+                    # router's per-replica version view (docs/SERVING.md
+                    # "Live model lifecycle").
+                    "model_version": engine.model_version,
+                    "weight_swaps": fault_counters["weight_swaps_total"],
+                    "swaps_rejected": fault_counters["swap_rejected_total"],
                     "bad_batches": fault_counters["bad_batches_total"],
                     "nonfinite_outputs": fault_counters["nonfinite_total"],
                     "restarts": fault_counters["engine_restarts_total"],
@@ -220,7 +257,7 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
 
         engine = self.engine
         try:
-            results = engine.predict(
+            results, versions = engine.predict_versioned(
                 samples,
                 timeout=getattr(self.server, "request_timeout_s", 60.0),
                 request_id=rid,
@@ -248,10 +285,20 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
             self._send_json(503, {"error": str(e), "request_id": rid})
             return
 
+        # The header (and body field) report the version that actually
+        # answered: the newest version any of the call's graphs executed
+        # against — for single-graph requests (the swap drill's shape) this
+        # is exact; a multi-graph call legitimately spanning a swap reports
+        # the newer version and carries the per-graph tags in the body.
+        call_versions = [v for v in versions if v]
+        if call_versions:
+            self._mv_override = call_versions[-1]
         self._send_json(
             200,
             {
                 "request_id": rid,
+                "model_version": call_versions[-1] if call_versions else None,
+                "model_versions": versions,
                 "heads": [
                     {"name": name, "type": htype, "dim": int(dim)}
                     for name, htype, dim in zip(
@@ -288,6 +335,9 @@ class InferenceServer:
         self.engine = engine
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.engine = engine  # type: ignore[attr-defined]
+        # Every response path names the serving model version (the
+        # lifecycle echo contract — see RequestPlumbing._model_version).
+        self._httpd.model_version_fn = lambda: engine.model_version  # type: ignore[attr-defined]
         self._httpd.verbose = verbose  # type: ignore[attr-defined]
         self._httpd.request_timeout_s = request_timeout_s  # type: ignore[attr-defined]
         self._httpd.replica_id = replica_id  # type: ignore[attr-defined]

@@ -159,6 +159,61 @@ def pytest_last_known_router_none_when_no_measurements(tmp_path):
     assert _last_known_router(str(tmp_path)) is None
 
 
+def pytest_last_known_swap_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_swap
+
+    real = {
+        "drills_total": 4,
+        "drills_passed": 4,
+        "swap_under_load": {
+            "p99_swap_over_steady": 1.32,
+            "recompiles_after_swap": 0,
+            "zero_version_torn": True,
+            "swap_wall_s": 0.008,
+        },
+        "platform": "cpu",
+        "device_kind": "cpu",
+    }
+    (tmp_path / "SWAP_r13.json").write_text(json.dumps(real))
+    # A failed --swap round carries no drill block — never "last known".
+    (tmp_path / "SWAP_r14.json").write_text(
+        json.dumps({"error": "TimeoutError"})
+    )
+    now = time.time()
+    os.utime(tmp_path / "SWAP_r13.json", (now - 50, now - 50))
+    os.utime(tmp_path / "SWAP_r14.json", (now - 10, now - 10))
+
+    blk = _last_known_swap(str(tmp_path))
+    assert blk is not None
+    assert blk["p99_swap_over_steady"] == 1.32
+    assert blk["recompiles_after_swap"] == 0
+    assert blk["zero_version_torn"] is True
+    assert blk["drills_passed"] == 4
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "SWAP_r13.json"
+
+
+def pytest_last_known_swap_none_when_no_measurements(tmp_path):
+    from bench import _last_known_swap
+
+    (tmp_path / "SWAP_bad.json").write_text("{not json")
+    (tmp_path / "SWAP_r09.json").write_text(json.dumps({"error": "boom"}))
+    assert _last_known_swap(str(tmp_path)) is None
+
+
+def pytest_committed_swap_artifact_readable():
+    """The committed SWAP_r* round is a valid last-known block with the
+    acceptance gates green (zero recompiles, zero torn responses)."""
+    from bench import _last_known_swap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_swap(repo)
+    assert blk is not None
+    assert blk["drills_passed"] == blk["drills_total"]
+    assert blk["recompiles_after_swap"] == 0
+    assert blk["zero_version_torn"] is True
+
+
 def pytest_last_known_kernels_picks_latest_real_round(tmp_path):
     from bench import _last_known_kernels
 

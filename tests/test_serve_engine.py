@@ -272,10 +272,10 @@ def pytest_nonfinite_output_fails_request_not_engine():
     state = {"poison": True}
 
     def nan_once(dev_batch):
-        outputs = real_execute(dev_batch)
+        outputs, version = real_execute(dev_batch)
         if state.pop("poison", False):
             outputs = [np.full_like(o, np.nan) for o in outputs]
-        return outputs
+        return outputs, version
 
     engine._execute = nan_once
     try:

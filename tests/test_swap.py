@@ -1,0 +1,478 @@
+"""graftswap (hydragnn_tpu/lifecycle/ + engine.swap_weights + router shadow
+mode) — the zero-downtime live model lifecycle.
+
+Covers the ISSUE-13 contract: fingerprint-mismatch rejection (engine keeps
+serving), per-request version consistency under concurrent swaps with a
+zero-recompile compile spy, promote/rollback round-trip through the
+keep_last_k manifest, corrupt-candidate fallback leaving the live version
+untouched (chain consumed, counters incremented), shadow diff gate pass AND
+fail driving promotion, bad-lifecycle config findings, HTTP e2e with the
+X-HydraGNN-Model-Version header on every path, and (slow) the supervisor
+kill-during-swap resume drill. Tier-1 except the kill drill, CPU.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.serve_load import (
+    _host_variables as _host_vars,
+    _perturb,
+    _swap_fixture,
+    build_serving_engine,
+)
+from hydragnn_tpu.analysis.sentinel import compile_count
+from hydragnn_tpu.checkpoint.io import save_model
+from hydragnn_tpu.lifecycle import (
+    CandidateVerificationError,
+    LifecycleManager,
+    ModelRegistry,
+    ShadowGate,
+    SwapGateError,
+    compare_outputs,
+)
+from hydragnn_tpu.route import InProcessReplica, Router
+from hydragnn_tpu.serve import InferenceServer, SwapFingerprintError
+
+# Small fast engines for the lifecycle tests (the bench rig uses the
+# flagship-family defaults; the contracts under test are size-independent).
+SMALL = dict(
+    hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0, pool_size=8
+)
+
+
+# ------------------------------------------------- 1. fingerprint rejection
+def pytest_swap_fingerprint_mismatch_rejected_engine_keeps_serving():
+    engine, graphs = build_serving_engine(model_version="live0", **SMALL)
+    try:
+        baseline = engine.predict([graphs[0]])[0]
+        vars0 = _host_vars(engine)
+        with pytest.raises(SwapFingerprintError):
+            engine.swap_weights(
+                {
+                    "params": {"wrong": np.zeros((2, 2), np.float32)},
+                    "batch_stats": vars0["batch_stats"],
+                },
+                "bad-candidate",
+            )
+        # The engine is untouched: same version, same (bit-exact) answers.
+        assert engine.model_version == "live0"
+        after = engine.predict([graphs[0]])[0]
+        assert all(
+            np.array_equal(a, b) for a, b in zip(baseline, after)
+        )
+        rejected = engine.metrics.read_counters("swap_rejected_total")
+        assert rejected["swap_rejected_total"] == 1
+    finally:
+        engine.close()
+
+
+# --------------------------- 2. consistency + zero recompile under swaps
+def pytest_swap_zero_recompile_and_version_consistency_under_concurrent_swaps():
+    engine, graphs = build_serving_engine(model_version="v0", **SMALL)
+    try:
+        vars0 = _host_vars(engine)
+        baseline = engine.predict([graphs[0]])[0]  # warms the bucket
+        publish_order = ["v0"] + [f"v{k}" for k in range(1, 6)]
+
+        c0 = compile_count()
+        stop = threading.Event()
+
+        def swapper():
+            # Same VALUES every time (outputs stay bit-identical) — the
+            # test isolates version plumbing from numerics.
+            for version in publish_order[1:]:
+                engine.swap_weights(vars0, version)
+
+        t = threading.Thread(target=swapper, daemon=True)
+        t.start()
+        futures = [
+            engine.submit(graphs[i % len(graphs)]) for i in range(32)
+        ]
+        results = [f.result(timeout=120) for f in futures]
+        t.join(120)
+        stop.set()
+        assert compile_count() - c0 == 0, "hot swaps must never recompile"
+
+        versions = [f.model_version for f in futures]
+        # Zero version-torn responses: every tag is a published version.
+        assert set(versions) <= set(publish_order), versions
+        # Monotonic: submissions resolve in order on the single dispatch
+        # thread, so observed versions never step backwards.
+        ranks = [publish_order.index(v) for v in versions]
+        assert ranks == sorted(ranks), versions
+        # Same weights => bit-identical outputs across every version
+        # (compare only the requests that sent the baseline graph).
+        same_graph = [
+            r for i, r in enumerate(results) if i % len(graphs) == 0
+        ]
+        for per_head in same_graph:
+            assert all(
+                np.array_equal(a, b) for a, b in zip(baseline, per_head)
+            )
+    finally:
+        engine.close()
+
+
+# ------------------------------------ 3. promote/rollback via the manifest
+def pytest_swap_promote_rollback_round_trip_via_manifest(tmp_path):
+    registry, engines, graphs, run_dir, vars0 = _swap_fixture(
+        str(tmp_path), n_replicas=1, **SMALL
+    )
+    engine = engines[0]
+    try:
+        manager = LifecycleManager(registry, engines)
+        live = registry.live
+        baseline = engine.predict([graphs[0]])[0]
+
+        save_model(
+            _perturb(vars0, 1e-2, seed=1),
+            None,
+            registry.name,
+            path=str(tmp_path),
+            meta={"epoch": 1},
+            keep_last_k=3,
+        )
+        cand = manager.stage_candidate()
+        c0 = compile_count()
+        report = manager.promote()
+        assert report["version"] == cand.short
+        assert engine.model_version == cand.short
+        assert registry.live.version == cand.version
+        assert registry.previous.version == live.version
+        assert registry.candidate is None
+        # Role records point at stable retained manifest files, not the
+        # volatile latest path.
+        manifest = json.load(
+            open(os.path.join(run_dir, registry.name + ".manifest.json"))
+        )
+        retained = {e["file"] for e in manifest["entries"]}
+        assert registry.live.file in retained
+        assert registry.previous.file in retained
+        # New weights actually serve (outputs moved).
+        promoted = engine.predict([graphs[0]])[0]
+        assert any(
+            not np.array_equal(a, b)
+            for a, b in zip(baseline, promoted)
+        )
+
+        rollback = manager.rollback()
+        assert rollback["version"] == live.short
+        assert engine.model_version == live.short
+        assert registry.live.version == live.version
+        assert registry.previous.version == cand.version  # roll-forwardable
+        restored = engine.predict([graphs[0]])[0]
+        assert all(
+            np.array_equal(a, b) for a, b in zip(baseline, restored)
+        )
+        assert compile_count() - c0 == 0, (
+            "promote+rollback of same-architecture weights must not compile"
+        )
+    finally:
+        engine.close()
+
+
+# --------------------------------------- 4. corrupt candidate falls back
+def pytest_swap_corrupt_candidate_fallback_live_untouched(tmp_path):
+    from hydragnn_tpu.faults import FaultCounters
+    from hydragnn_tpu.faults.plan import FaultPlan
+
+    registry, engines, graphs, run_dir, vars0 = _swap_fixture(
+        str(tmp_path), n_replicas=1, **SMALL
+    )
+    engine = engines[0]
+    try:
+        manager = LifecycleManager(registry, engines)
+        live = registry.live
+        save_model(
+            _perturb(vars0, 1e-2, seed=2),
+            None,
+            registry.name,
+            path=str(tmp_path),
+            meta={"epoch": 1},
+            keep_last_k=3,
+        )
+        manager.stage_candidate()
+        # Seeded bit-flip via the faults layer on the candidate's file; the
+        # retained entry hard-links the same inode, so the verified chain
+        # must walk past BOTH to the intact epoch-0 version.
+        FaultPlan._flip_byte(
+            os.path.join(run_dir, registry.name + ".pk"), seed=5
+        )
+        before = FaultCounters.get("ckpt_corrupt_detected")
+        with pytest.raises(CandidateVerificationError):
+            manager.promote()
+        assert FaultCounters.get("ckpt_corrupt_detected") - before >= 1
+        # Live untouched: same version, still answering.
+        assert engine.model_version == live.short
+        assert registry.live.version == live.version
+        assert engine.predict([graphs[0]])[0] is not None
+        # The fallback walk was recorded for operators.
+        assert os.path.exists(os.path.join(run_dir, "supervisor.json"))
+    finally:
+        engine.close()
+
+
+# ------------------------------------------- 5. shadow diff gate pass/fail
+def pytest_shadow_compare_and_gate_units():
+    live = [[np.ones((3,), np.float32), np.zeros((2, 1), np.float32)]]
+    ok = compare_outputs(live, live, bound=1e-9)
+    assert ok["ok"] and ok["fwd_err"] == 0.0
+    bad = [[np.ones((3,), np.float32) * 2.0, np.zeros((2, 1), np.float32)]]
+    fail = compare_outputs(live, bad, bound=1e-3)
+    assert not fail["ok"] and fail["fwd_err"] == 1.0
+
+    gate = ShadowGate(tolerance=1e-3, min_samples=2)
+    assert not gate.report()["green"]  # starved gate stays red
+    gate.record(ok)
+    gate.record(ok)
+    assert gate.report()["green"]
+    gate.record(fail)
+    report = gate.report()
+    assert not report["green"] and report["failures"] == 1
+    assert "hydragnn_swap_shadow_gate_green 0" in gate.render_prometheus()
+    with pytest.raises(ValueError):
+        ShadowGate(tolerance=0.0)
+
+
+def pytest_shadow_gate_refuses_bad_model_then_green_promotes(tmp_path):
+    registry, engines, graphs, _run_dir, vars0 = _swap_fixture(
+        str(tmp_path), n_replicas=1, **SMALL
+    )
+    engine = engines[0]
+    shadow_engine = None
+    router = None
+    try:
+        live = registry.live
+        bad = _perturb(vars0, 0.5, seed=3)
+        save_model(
+            bad, None, registry.name, path=str(tmp_path),
+            meta={"epoch": 1}, keep_last_k=3,
+        )
+        cand = registry.stage_candidate()
+        shadow_engine, _ = build_serving_engine(
+            model_version="pending", **SMALL
+        )
+        shadow_engine.swap_weights(bad, cand.short)
+        router = Router(
+            [InProcessReplica("replica-0", engine)],
+            health_interval_s=0.1,
+            jitter_seed=0,
+        )
+        manager = LifecycleManager(registry, engines, router=router)
+
+        def drive(prefix, n=8):
+            import time
+
+            gate = router.shadow_report()
+            target = gate["compared"] + 3
+            for i in range(n):
+                router.predict(
+                    [graphs[i % len(graphs)]], request_id=f"{prefix}-{i}"
+                )
+            deadline = time.perf_counter() + 60
+            while time.perf_counter() < deadline:
+                if router.shadow_report()["compared"] >= target:
+                    return
+                time.sleep(0.02)
+            raise AssertionError("shadow comparisons never completed")
+
+        # RED: deliberately-perturbed candidate vs tight tolerance.
+        router.set_shadow(
+            InProcessReplica("shadow-cand", shadow_engine),
+            fraction=1.0,
+            tolerance=1e-6,
+            min_samples=3,
+        )
+        drive("red")
+        report = router.shadow_report()
+        assert report["configured"] and not report["green"]
+        assert report["failures"] >= 1
+        with pytest.raises(SwapGateError):
+            manager.promote()
+        assert engine.model_version == live.short  # untouched
+        # Shadow traffic is invisible to admission/SLO accounting.
+        assert router.queue_depth() == 0
+
+        # GREEN: same candidate under a bound it meets -> promotion flips
+        # live, and the shadow arm is cleared.
+        router.clear_shadow()
+        router.set_shadow(
+            InProcessReplica("shadow-cand2", shadow_engine),
+            fraction=1.0,
+            tolerance=1e6,
+            min_samples=3,
+        )
+        drive("green")
+        assert router.shadow_report()["green"]
+        report = manager.promote()
+        assert report["version"] == cand.short
+        assert engine.model_version == cand.short
+        assert not router.shadow_report()["configured"]
+    finally:
+        if router is not None:
+            router.close()
+        engine.close()
+        if shadow_engine is not None:
+            shadow_engine.close()
+
+
+def pytest_set_shadow_validates_fraction():
+    router = Router([], autostart_health=False)
+    try:
+        with pytest.raises(ValueError):
+            router.set_shadow(object(), fraction=0.0, tolerance=1e-3)
+        with pytest.raises(ValueError):
+            router.set_shadow(object(), fraction=1.5, tolerance=1e-3)
+        with pytest.raises(ValueError):
+            router.set_shadow(object(), fraction=0.5, tolerance=-1.0)
+    finally:
+        router.close()
+
+
+# ------------------------------------------------ 6. bad-lifecycle findings
+def pytest_check_config_bad_lifecycle_findings(tmp_path):
+    from hydragnn_tpu.analysis.contracts import check_config
+    from hydragnn_tpu.checkpoint.format import file_content_identity
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "tests", "inputs", "ci.json")) as f:
+        cfg = json.load(f)
+
+    def codes(lifecycle):
+        report = check_config(
+            cfg, mode="training", deep=False, strict=False,
+            lifecycle=lifecycle,
+        )
+        return [e["code"] for e in report["errors"]], report
+
+    # shadow fraction outside (0, 1]
+    bad, _ = codes({"shadow_fraction": 1.5, "tolerance": 1e-3})
+    assert bad == ["bad-lifecycle"]
+    bad, _ = codes({"shadow_fraction": 0.0, "tolerance": 1e-3})
+    assert bad == ["bad-lifecycle"]
+    # shadow without a tolerance bound
+    bad, report = codes({"shadow_fraction": 0.2})
+    assert bad == ["bad-lifecycle"]
+    assert "tolerance" in report["errors"][0]["message"]
+    # rollback with keep_last_k < 2
+    bad, _ = codes({"rollback": True, "keep_last_k": 1})
+    assert bad == ["bad-lifecycle"]
+    # swap target fingerprint mismatch vs the declared expectation
+    engine, _graphs = build_serving_engine(**SMALL)
+    try:
+        vars0 = _host_vars(engine)
+    finally:
+        engine.close()
+    save_model(vars0, None, "tgt", path=str(tmp_path))
+    target = os.path.join(str(tmp_path), "tgt", "tgt.pk")
+    _identity, header = file_content_identity(target)
+    bad, _ = codes(
+        {"swap_target": target, "expected_fingerprint": "deadbeef"}
+    )
+    assert bad == ["bad-lifecycle"]
+    # matching fingerprint: clean
+    bad, _ = codes(
+        {
+            "swap_target": target,
+            "expected_fingerprint": header["param_fingerprint"],
+        }
+    )
+    assert bad == []
+    # unreadable/corrupt swap target
+    from hydragnn_tpu.faults.plan import FaultPlan
+
+    FaultPlan._flip_byte(target, seed=1)
+    bad, _ = codes({"swap_target": target})
+    assert bad == ["bad-lifecycle"]
+    # clean lifecycle config passes
+    ok_report = check_config(
+        cfg, mode="training", deep=False, strict=False,
+        lifecycle={
+            "shadow_fraction": 0.25,
+            "tolerance": 1e-3,
+            "rollback": True,
+            "keep_last_k": 3,
+        },
+    )
+    assert ok_report["ok"]
+
+
+# ----------------------------------------------- 7. HTTP e2e version headers
+def pytest_swap_http_e2e_version_headers():
+    from hydragnn_tpu.route import HttpReplica
+
+    engine, graphs = build_serving_engine(model_version="live0", **SMALL)
+    server = InferenceServer(engine, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        # /healthz carries the version (header + payload).
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+            assert resp.headers["X-HydraGNN-Model-Version"] == "live0"
+        assert health["model_version"] == "live0"
+        assert health["weight_swaps"] == 0
+
+        # /predict 200 carries it in header AND body.
+        def post(doc):
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read()), dict(resp.headers)
+
+        g = graphs[0]
+        gd = {"x": np.asarray(g.x).tolist()}
+        if g.edge_index is not None:
+            gd["edge_index"] = np.asarray(g.edge_index).tolist()
+        if g.edge_attr is not None:
+            gd["edge_attr"] = np.asarray(g.edge_attr).tolist()
+        body, headers = post({"graphs": [gd]})
+        assert headers["X-HydraGNN-Model-Version"] == "live0"
+        assert body["model_version"] == "live0"
+        assert body["model_versions"] == ["live0"]
+
+        # Every path echoes it, like the request-id header (404 here).
+        req = urllib.request.Request(base + "/nope", data=b"{}")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert e.headers["X-HydraGNN-Model-Version"] == "live0"
+
+        # Hot swap: subsequent responses carry the new version, and the
+        # HttpReplica backend surfaces it to the router's health map.
+        vars0 = _host_vars(engine)
+        engine.swap_weights(vars0, "live1")
+        body, headers = post({"graphs": [gd]})
+        assert headers["X-HydraGNN-Model-Version"] == "live1"
+        assert body["model_version"] == "live1"
+        replica = HttpReplica("r0", base)
+        _results, version = replica.predict_versioned([g])
+        assert version == "live1"
+        assert replica.health()["model_version"] == "live1"
+        assert replica.health()["weight_swaps"] == 1
+    finally:
+        server.shutdown()
+
+
+# -------------------------------------- 8. kill-during-swap resume (slow)
+@pytest.mark.slow
+def pytest_supervisor_kill_during_swap_resume():
+    from benchmarks.serve_load import kill_during_swap_drill
+
+    result = kill_during_swap_drill()
+    assert result["killed_mid_swap"], result
+    assert result["state_consistent_after_kill"], result
+    assert result["resumed"], result
+    assert result["promoted_after_restart"], result
+    assert result["ok"], result
