@@ -1054,6 +1054,91 @@ def compile_cache_main() -> int:
     return 0 if result.get("ok") else 1
 
 
+def _last_known_multichip(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent real overlapped-vs-single-psum A/B from any committed
+    MULTICHIP_* artifact — the graftmesh analog of ``_last_known_hardware``.
+    A failed ``--multichip`` round embeds this block with
+    ``provenance: "stale"`` so an rc=1 round still carries the last known
+    overlap fraction + scaling curve. Pre-graftmesh MULTICHIP artifacts
+    (dry-run smokes, no ``metric`` field) are skipped."""
+
+    def extract(doc):
+        if not doc.get("value") or doc.get("metric") != "multichip_overlap_ab":
+            return None
+        return {
+            "value": doc["value"],
+            "unit": doc.get("unit"),
+            "devices": doc.get("devices"),
+            "overlap_fraction": doc.get("overlap_fraction"),
+            "grads_allclose_ok": doc.get("grads_allclose_ok"),
+            "timings_meaningful": doc.get("timings_meaningful"),
+            "backend": doc.get("backend"),
+        }
+
+    return _latest_artifact_block("MULTICHIP_*.json", extract, search_dir)
+
+
+def multichip_main() -> int:
+    """``python bench.py --multichip``: the graftmesh overlapped-vs-single-
+    psum A/B (benchmarks/multichip_ab.py) — per-arm steady step times at the
+    top mesh size, measured overlap fraction against the 1-device compute
+    floor, a scaling curve over 1/2/4/8 (virtual) devices, and the
+    cross-arm grads-allclose gate. Writes MULTICHIP_rNN.json; failure embeds
+    the last known round, stale-labeled, per the established convention.
+    CPU timings are labeled non-meaningful (virtual mesh oversubscription)."""
+    result = {
+        "metric": "multichip_overlap_ab",
+        "value": 0.0,
+        "unit": "x_single_psum_vs_bucketed_step",
+    }
+    from hydragnn_tpu.utils.artifacts import round_tag
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"MULTICHIP_r{round_tag()}.json",
+    )
+    try:
+        # Pin a >1-device topology BEFORE the first jax import (bench.py has
+        # no top-level jax): a stock single-device CPU host must produce a
+        # fresh artifact out of the box, on the same virtual-mesh terms as
+        # the scaling sweep. HYDRAGNN_TPU_TESTS=1 leaves the real
+        # accelerator as the backend for the hardware round.
+        n = int(os.environ.get("HYDRAGNN_HOST_DEVICES", "8"))
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        )
+        import jax
+
+        if os.environ.get("HYDRAGNN_TPU_TESTS") != "1":
+            jax.config.update("jax_platforms", "cpu")
+
+        result["backend"] = jax.default_backend()
+        result["device_kind"] = jax.devices()[0].device_kind
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.multichip_ab import run_multichip_ab
+
+        result.update(run_multichip_ab())
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        result["artifact"] = os.path.basename(out_path)
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        try:
+            stale = _last_known_multichip()
+            if stale is not None:
+                result["last_known_multichip"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0 if result.get("ok") else 1
+
+
 def _last_known_precision(search_dir: "str | None" = None) -> "dict | None":
     """Most recent real mixed-precision A/B from any committed PRECISION_*
     artifact — the graftprec analog of ``_last_known_hardware``. A failed
@@ -1932,6 +2017,8 @@ if __name__ == "__main__":
         sys.exit(trace_main())
     if "--compile-cache" in sys.argv:
         sys.exit(compile_cache_main())
+    if "--multichip" in sys.argv:
+        sys.exit(multichip_main())
     if "--precision" in sys.argv:
         sys.exit(precision_main())
     if "--analyze" in sys.argv:

@@ -1,0 +1,217 @@
+"""Overlapped-vs-single-psum gradient-sync A/B over a device mesh — the
+measurement half of ``bench.py --multichip`` (graftmesh, docs/DISTRIBUTED.md).
+
+Arms (train/trainer.make_train_step_dp ``grad_sync``):
+
+  single    one whole-tree psum after the full backward (the historical step)
+  bucketed  per-bucket psum-in-backward — each bucket's all-reduce depends
+            only on its own backward segment (parallel/overlap.py)
+  ring      the same bucket hooks with an explicit ppermute ring all-reduce
+
+Measured per arm: steady step wall (interleaved min-of-windows, the repo's
+timing convention), plus a 1-device-mesh compute baseline (``t_nosync`` — the
+weak-scaling per-device compute floor with zero cross-device collectives)
+that turns the arm deltas into an OVERLAP FRACTION::
+
+    overlap = (t_single - t_arm) / (t_single - t_nosync)   clamped to [0, 1]
+
+i.e. the share of the gradient all-reduce wall hidden behind backward
+compute. On a virtual CPU mesh the devices oversubscribe host cores and XLA
+runs collectives synchronously, so the fraction is a PLUMBING CANARY there —
+``timings_meaningful: false`` labels it, exactly like every other CPU-round
+artifact; the north-star number rides the next hardware batch.
+
+Gates (CPU-meaningful, backend-independent):
+  * grads_allclose_ok — one step per arm from identical state must agree on
+    the updated params within float32 reduction-order noise;
+  * every arm's scaling sweep runs under a real >1-size mesh with finite loss.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PER_DEV_BATCH = 16
+STEPS = 8
+WINDOWS = 3
+ARMS = ("single", "bucketed", "ring")
+ALLCLOSE_RTOL = 1e-4
+ALLCLOSE_ATOL = 1e-5
+
+
+def _workload(n_devices: int, hidden: int, layers: int, seed: int = 0):
+    """Per-device stacked batch + model/opt/state for a D-device data mesh —
+    the same flagship-shaped synthetic workload scaling.py sweeps."""
+    import jax
+
+    from __graft_entry__ import DIMS, TYPES, _build_model, _make_graphs
+    from hydragnn_tpu.graphs import collate_graphs
+    from hydragnn_tpu.models import init_model_variables
+    from hydragnn_tpu.train.trainer import create_train_state, stack_batches
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    rng = np.random.default_rng(seed)
+    per_dev = [
+        collate_graphs(
+            _make_graphs(PER_DEV_BATCH, rng, 12, 26), TYPES, DIMS,
+            num_nodes_pad=PER_DEV_BATCH * 26,
+            num_edges_pad=PER_DEV_BATCH * 26 * 20,
+            num_graphs_pad=PER_DEV_BATCH + 1,
+            edge_dim=1,
+        )
+        for _ in range(n_devices)
+    ]
+    batch = stack_batches(per_dev, n_devices)
+    model = _build_model(hidden=hidden, layers=layers)
+    variables = init_model_variables(model, per_dev[0])
+    opt = select_optimizer("AdamW", 1e-3)
+    state = create_train_state(model, variables, opt)
+    return model, opt, state, batch
+
+
+def _steady_step_s(step, state, batch, rng) -> float:
+    """Min-of-windows steady step wall for one compiled step (state NOT
+    donated — the caller reuses it across arms)."""
+    import jax
+
+    state, m = step(state, batch, rng)  # compile + warm
+    jax.block_until_ready(m["loss"])
+    best = float("inf")
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, m = step(state, batch, rng)
+        jax.block_until_ready(m["loss"])
+        best = min(best, (time.perf_counter() - t0) / STEPS)
+    return best
+
+
+def run_multichip_ab(
+    device_sizes: Optional[Sequence[int]] = None,
+    hidden: int = 64,
+    layers: int = 3,
+) -> Dict:
+    import jax
+
+    from hydragnn_tpu.parallel.distributed import make_mesh, mesh_descriptor
+    from hydragnn_tpu.parallel.overlap import overlap_fraction
+    from hydragnn_tpu.train.trainer import make_train_step_dp
+
+    n_avail = len(jax.devices())
+    if device_sizes is None:
+        device_sizes = [d for d in (1, 2, 4, 8) if d <= n_avail]
+    sizes = sorted(set(int(d) for d in device_sizes))
+    top = sizes[-1]
+    if top < 2:
+        raise RuntimeError(
+            f"multichip A/B needs >= 2 devices ({n_avail} visible) — pin "
+            "XLA_FLAGS=--xla_force_host_platform_device_count"
+        )
+    rng = jax.random.PRNGKey(0)
+
+    # ---- equivalence gate: one step per arm from identical state ----------
+    model, opt, state, batch = _workload(top, hidden, layers)
+    steps = {
+        arm: make_train_step_dp(
+            model, opt, make_mesh(data_axis=top), donate=False,
+            grad_sync=arm, grad_bucket_mb=1.0,
+        )
+        for arm in ARMS
+    }
+    stepped = {arm: steps[arm](state, batch, rng) for arm in ARMS}
+    ref = jax.tree_util.tree_leaves(stepped["single"][0].params)
+    grads_allclose_ok = True
+    max_err = 0.0
+    for arm in ("bucketed", "ring"):
+        for a, b in zip(ref, jax.tree_util.tree_leaves(stepped[arm][0].params)):
+            a, b = np.asarray(a), np.asarray(b)
+            err = float(np.max(np.abs(a - b) / (np.abs(a) + ALLCLOSE_ATOL)))
+            max_err = max(max_err, err)
+            if not np.allclose(a, b, rtol=ALLCLOSE_RTOL, atol=ALLCLOSE_ATOL):
+                grads_allclose_ok = False
+    losses = {
+        arm: float(stepped[arm][1]["loss"]) / max(float(stepped[arm][1]["count"]), 1)
+        for arm in ARMS
+    }
+
+    # ---- steady A/B at the top mesh size ----------------------------------
+    # (plus the 1-device compute floor for the overlap fraction)
+    m1, o1, s1, b1 = _workload(1, hidden, layers)
+    step1 = make_train_step_dp(m1, o1, make_mesh(data_axis=1), donate=False)
+    t_nosync = _steady_step_s(step1, s1, b1, rng)
+    arm_times = {
+        arm: _steady_step_s(steps[arm], state, batch, rng) for arm in ARMS
+    }
+    overlap = {
+        arm: overlap_fraction(arm_times["single"], arm_times[arm], t_nosync)
+        for arm in ("bucketed", "ring")
+    }
+
+    # ---- scaling curve over 1/2/4/8 virtual devices per arm ---------------
+    scaling: List[Dict] = []
+    for d in sizes:
+        mesh = make_mesh(data_axis=d)
+        md, od, sd, bd = _workload(d, hidden, layers)
+        row: Dict = {"devices": d, "mesh": mesh_descriptor(mesh)}
+        for arm in ARMS if d > 1 else ("single",):
+            sarm = make_train_step_dp(
+                md, od, mesh, donate=False, grad_sync=arm, grad_bucket_mb=1.0
+            )
+            t = _steady_step_s(sarm, sd, bd, rng)
+            row[f"step_s_{arm}"] = round(t, 6)
+            row[f"graphs_per_sec_{arm}"] = round(PER_DEV_BATCH * d / t, 1)
+        scaling.append(row)
+
+    virtual = jax.default_backend() == "cpu"
+    speedup = round(arm_times["single"] / arm_times["bucketed"], 3)
+    return {
+        "ok": bool(grads_allclose_ok),
+        "value": speedup,
+        "devices": top,
+        "mesh": mesh_descriptor(make_mesh(data_axis=top)),
+        "per_device_batch": PER_DEV_BATCH,
+        "hidden": hidden,
+        "layers": layers,
+        "virtual_mesh": virtual,
+        "timings_meaningful": not virtual,
+        "grads_allclose_ok": bool(grads_allclose_ok),
+        "grads_max_rel_err": round(max_err, 8),
+        "loss_per_arm": {k: round(v, 6) for k, v in losses.items()},
+        "step_s": {k: round(v, 6) for k, v in arm_times.items()},
+        "step_s_nosync_1dev": round(t_nosync, 6),
+        "overlap_fraction": {
+            k: (None if v is None else round(v, 3))
+            for k, v in overlap.items()
+        },
+        "scaling": scaling,
+        "note": (
+            "virtual CPU mesh: devices oversubscribe host cores and XLA "
+            "runs collectives synchronously — step times and overlap "
+            "fractions are plumbing canaries only; the hardware number "
+            "rides the next TPU batch"
+        )
+        if virtual
+        else "real device mesh",
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    n = int(os.environ.get("HYDRAGNN_HOST_DEVICES", "8"))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}"
+    )
+    import jax
+
+    if os.environ.get("HYDRAGNN_TPU_TESTS") != "1":
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_multichip_ab(), indent=2))

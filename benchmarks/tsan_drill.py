@@ -281,6 +281,41 @@ def _swap_drill(tmpdir: str) -> None:
         engine.close()
 
 
+def _mesh_drill() -> None:
+    """graftmesh path (ISSUE 14): the loopback rendezvous hammered by worker
+    threads — the instrumented LoopbackRendezvous._lock races the two-phase
+    barrier protocol across exchange/broadcast/barrier rounds, the
+    lockstep-divergence detector reads racing tag slots, and an injected
+    worker death exercises the abort path (broken barriers must surface as
+    LoopbackError, never a hang or a silent thread death)."""
+    from hydragnn_tpu.parallel import LoopbackError, run_workers
+
+    def worker(w):
+        acc = []
+        for i in range(12):
+            got = w.exchange((w.rank, i), tag="mesh_drill")
+            acc.append(got)
+            assert [g[1] for g in got] == [i] * w.world_size
+            if i % 3 == 0:
+                w.barrier(f"round{i}")
+            acc.append(w.broadcast(i if w.rank == 1 else None, src=1))
+        return len(acc)
+
+    assert run_workers(4, worker) == [24, 24, 24, 24]
+
+    def dying(w):
+        if w.rank == 2:
+            raise RuntimeError("mesh drill injected death")
+        w.exchange(w.rank)
+
+    try:
+        run_workers(3, dying)
+    except LoopbackError:
+        pass
+    else:  # pragma: no cover - drill invariant
+        raise AssertionError("loopback abort path did not surface the death")
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -291,6 +326,7 @@ def run_drill(seed: int) -> dict:
         _cache_drill(tmpdir)
         _route_drill()
         _swap_drill(tmpdir)
+        _mesh_drill()
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

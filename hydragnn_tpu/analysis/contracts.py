@@ -21,6 +21,11 @@ Every failure is one actionable line tagged with a stable code:
                     nonsense (unknown arm, int8 for training, non-positive
                     scale knobs, quantized serve without a tolerance bound)
   oob-bucket        a bucket/batch/ladder size cannot hold the data
+  bad-mesh          distributed/mesh config nonsense (axis sizes vs the
+                    visible device count, graph_axis with the CSR/sorted
+                    contract explicitly disabled, unknown grad_sync arm,
+                    non-positive grad bucket size, elastic worker-range
+                    knobs that cannot be satisfied) — docs/DISTRIBUTED.md
   bad-router        multi-replica router config nonsense (replica count /
                     hash-ring weights / admission classes without deadlines /
                     fleet ladder-memory blowout) — docs/SERVING.md
@@ -126,6 +131,7 @@ def check_config(
         arch, training, mode, serve_precision, serve_tolerance, errors
     )
     _check_buckets(config, arch, training, bucket_ladder, mode, errors)
+    _check_mesh(training, deep, errors)
     if router is not None:
         _check_router(router, bucket_ladder, errors)
     if lifecycle is not None:
@@ -882,6 +888,133 @@ def _check_buckets(config, arch, training, bucket_ladder, mode, errors):
     if ga is not None and (not isinstance(ga, int) or ga < 1):
         errors.append(
             ("oob-bucket", f"Training.graph_axis {ga!r} must be an int >= 1")
+        )
+
+
+# ----------------------------------------------------------------- mesh/graftmesh
+def _check_mesh(training, deep, errors):
+    """graftmesh config contract (docs/DISTRIBUTED.md): mesh-axis requests
+    the visible devices cannot satisfy, a graph-partitioned run with the
+    CSR/sorted aggregation contract explicitly disabled, unknown
+    gradient-sync arms, nonsense bucket sizes, and unsatisfiable elastic
+    worker ranges are one actionable ``bad-mesh`` line each — before any
+    mesh builds or a shard_map step compiles.
+
+    bf16 + mesh is deliberately NOT a finding since graftmesh: the
+    loss-scale state machine rides the mesh step with the backoff update in
+    lockstep post-psum (train/trainer._dp_local_graftmesh), closing ROADMAP
+    item 3's explicit rejection.
+
+    The device-count comparison runs only under ``deep`` — counting devices
+    initializes the XLA backend, which the structural-only gate (the
+    supervisor's pre-spawn path) must never do."""
+    import os
+
+    ga = training.get("graph_axis")
+    ga = ga if isinstance(ga, int) and ga >= 1 else 1
+    if ga > 1 and os.environ.get("HYDRAGNN_SEGMENT_SORTED") in (
+        "0", "false", "False",
+    ):
+        errors.append(
+            (
+                "bad-mesh",
+                f"Training.graph_axis={ga} with HYDRAGNN_SEGMENT_SORTED "
+                "disabled: graph-partitioned training's halo/edge-cut "
+                "exchange is built on the CSR/sorted contract "
+                "(ops localize row_ptr per edge shard) — re-enable the "
+                "sorted path or drop graph_axis",
+            )
+        )
+    if ga > 1 and deep:
+        import jax
+
+        n = jax.device_count()
+        if ga > n:
+            errors.append(
+                (
+                    "bad-mesh",
+                    f"Training.graph_axis={ga} exceeds the {n} visible "
+                    "device(s) — the mesh cannot build; shrink graph_axis "
+                    "or pin more virtual devices "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count)",
+                )
+            )
+    gs = training.get("grad_sync")
+    if gs is not None:
+        from ..parallel.overlap import GRAD_SYNC_MODES
+
+        if gs not in GRAD_SYNC_MODES:
+            errors.append(
+                (
+                    "bad-mesh",
+                    f"Training.grad_sync {gs!r} is not one of "
+                    f"{GRAD_SYNC_MODES}",
+                )
+            )
+    gbm = training.get("grad_bucket_mb")
+    if gbm is not None and (
+        isinstance(gbm, bool)
+        or not isinstance(gbm, (int, float))
+        or gbm <= 0
+    ):
+        errors.append(
+            (
+                "bad-mesh",
+                f"Training.grad_bucket_mb {gbm!r} must be a positive number "
+                "(megabytes per gradient all-reduce bucket)",
+            )
+        )
+    elastic = training.get("elastic")
+    if elastic is None:
+        return
+    if not isinstance(elastic, dict):
+        errors.append(
+            (
+                "bad-mesh",
+                "Training.elastic must be a dict of worker-range knobs "
+                f"(min_workers/max_workers/heartbeat_s), got "
+                f"{type(elastic).__name__}",
+            )
+        )
+        return
+    unknown = sorted(
+        set(elastic) - {"min_workers", "max_workers", "heartbeat_s"}
+    )
+    if unknown:
+        errors.append(
+            ("bad-mesh", f"Training.elastic has unknown knob(s) {unknown}")
+        )
+    mn, mx = elastic.get("min_workers", 1), elastic.get("max_workers")
+    bounds_ok = True
+    for name, val in (("min_workers", mn), ("max_workers", mx)):
+        if val is not None and (
+            isinstance(val, bool) or not isinstance(val, int) or val < 1
+        ):
+            errors.append(
+                (
+                    "bad-mesh",
+                    f"Training.elastic.{name} {val!r} must be an int >= 1",
+                )
+            )
+            bounds_ok = False
+    if bounds_ok and mx is not None and mn is not None and mn > mx:
+        errors.append(
+            (
+                "bad-mesh",
+                f"Training.elastic min_workers={mn} > max_workers={mx} — "
+                "no world size satisfies the range",
+            )
+        )
+    hb = elastic.get("heartbeat_s")
+    if hb is not None and (
+        isinstance(hb, bool) or not isinstance(hb, (int, float)) or hb <= 0
+    ):
+        errors.append(
+            (
+                "bad-mesh",
+                f"Training.elastic.heartbeat_s {hb!r} must be a positive "
+                "number of seconds",
+            )
         )
 
 

@@ -119,7 +119,15 @@ class CacheKey:
     ``(N_pad, E_pad, G_pad)`` (zeros when the program is not bucket-shaped);
     ``args_digest`` is the full argument-signature fingerprint
     (:func:`tree_signature`), which subsumes the bucket for correctness —
-    the bucket stays a named field for observability (ls/manifest)."""
+    the bucket stays a named field for observability (ls/manifest).
+
+    ``mesh`` is the graftmesh axis-layout component
+    (``parallel.distributed.mesh_descriptor``, e.g. ``"data:4xgraph:2"``):
+    shard_map programs compiled for one mesh shape must never hydrate
+    another's entries even when every array shape agrees (the environment
+    topology pins the device COUNT; this pins the axis FACTORIZATION).
+    Empty = single-device program — omitted from the canonical JSON so every
+    pre-graftmesh store digest (and warm store) is preserved."""
 
     program: str
     jax_version: str
@@ -130,6 +138,7 @@ class CacheKey:
     flags: Tuple[str, ...] = ()
     bucket: Tuple[int, int, int] = (0, 0, 0)
     args_digest: str = ""
+    mesh: str = ""
 
     @classmethod
     def for_environment(
@@ -140,6 +149,7 @@ class CacheKey:
         bucket: Tuple[int, int, int] = (0, 0, 0),
         args_digest: str = "",
         env: Optional[Dict[str, str]] = None,
+        mesh: str = "",
     ) -> "CacheKey":
         env = env if env is not None else environment_fingerprint()
         return cls(
@@ -152,12 +162,17 @@ class CacheKey:
             flags=tuple(sorted(flags)),
             bucket=(int(bucket[0]), int(bucket[1]), int(bucket[2])),
             args_digest=args_digest,
+            mesh=str(mesh),
         )
 
     def to_json(self) -> Dict[str, Any]:
         doc = asdict(self)
         doc["flags"] = list(self.flags)
         doc["bucket"] = list(self.bucket)
+        if not self.mesh:
+            # Canonical-JSON stability: single-device keys keep their
+            # pre-graftmesh digests, so existing stores stay warm.
+            doc.pop("mesh")
         return doc
 
     @classmethod
@@ -173,6 +188,7 @@ class CacheKey:
             flags=tuple(doc.get("flags") or ()),
             bucket=(int(bucket[0]), int(bucket[1]), int(bucket[2])),
             args_digest=doc.get("args_digest", ""),
+            mesh=doc.get("mesh", ""),
         )
 
     def digest(self) -> str:

@@ -117,12 +117,27 @@ def run_supervised(
     cfg_path = os.path.join(run_dir, "supervisor_config.json")
     _atomic_write_json(cfg_path, cfg)
 
+    # graftmesh elastic-restart metadata (docs/DISTRIBUTED.md "Elastic
+    # runbook"): the mesh/worker topology this supervised run was launched
+    # under, persisted BEFORE the first child so a post-mortem (or an elastic
+    # rejoin deciding whether a checkpoint's world shape matches) never has
+    # to re-derive it from env archaeology.
+    from ..parallel.distributed import init_comm_size_and_rank
+
+    training_cfg = cfg.get("NeuralNetwork", {}).get("Training", {})
+    world_size, _rank = init_comm_size_and_rank()
     meta = {
         "log_name": log_name,
         "max_restarts": int(max_restarts),
         "restarts": 0,
         "completed": False,
         "attempts": [],
+        "mesh": {
+            "world_size": world_size,
+            "graph_axis": int(training_cfg.get("graph_axis") or 1),
+            "grad_sync": training_cfg.get("grad_sync") or "single",
+            "elastic": training_cfg.get("elastic") or None,
+        },
     }
     meta_path = os.path.join(run_dir, SUPERVISOR_META)
     # Children import hydragnn_tpu by module path regardless of the run's

@@ -789,7 +789,7 @@ def fused_segment_stats(
     if interpret is None:
         interpret = _platform() != "tpu"
     use_sorted, use_csr_kernel, row_ptr = _sorted_route(
-        sorted_ids, row_ptr, axis_name
+        sorted_ids, row_ptr, axis_name, num_local_edges=segment_ids.shape[0]
     )
     if use_sorted or use_csr_kernel:
         # Sorted/CSR contract: zero masked rows, keep RAW (sorted) ids — a -1
@@ -1174,20 +1174,50 @@ def _flatten_trailing(data):
     )
 
 
-def _sorted_route(sorted_ids: bool, row_ptr, axis_name):
+def localize_row_ptr(row_ptr, axis_name, num_local_edges: int):
+    """Global CSR boundaries → THIS edge shard's local boundaries (graftmesh
+    halo/edge-cut contract, docs/DISTRIBUTED.md).
+
+    Edge-sharded graph parallelism slices the destination-sorted edge list
+    into equal contiguous shards (shard_map's even split over the edge axis),
+    so shard ``s`` owns global rows ``[s*E_loc, (s+1)*E_loc)`` and a node's
+    local run is the global run clamped into that window::
+
+        local_row_ptr[n] = clip(global_row_ptr[n] - s*E_loc, 0, E_loc)
+
+    Nodes whose edges live entirely on another shard get an empty local run
+    (left == right), nodes cut by the shard boundary get exactly their local
+    rows — the subsequent psum over ``axis_name`` is the halo exchange that
+    sums each node's per-shard partial aggregates. Must be called INSIDE the
+    sharded computation (``lax.axis_index`` needs the bound axis)."""
+    start = jax.lax.axis_index(axis_name).astype(jnp.int32) * jnp.int32(
+        num_local_edges
+    )
+    return jnp.clip(
+        row_ptr.astype(jnp.int32) - start, 0, jnp.int32(num_local_edges)
+    )
+
+
+def _sorted_route(sorted_ids: bool, row_ptr, axis_name, num_local_edges=None):
     """ONE resolution of the sorted/CSR dispatch every fused wrapper uses.
 
     Returns ``(use_sorted, use_csr_kernel, row_ptr)``: the sorted prefix
     path when enabled (precedence unchanged from r05), else the CSR
     run-walk kernel when the caller supplied boundaries under the
-    HYDRAGNN_PALLAS opt-in. ``row_ptr`` comes back nulled under an
-    ``axis_name`` — global edge offsets are wrong for a local edge shard, so
-    sharded traffic re-derives boundaries locally. Centralized so a routing
-    change cannot silently diverge between wrappers (a missed site would
-    send that wrapper's traffic back to the scatter path — the 0.47x
-    regression class the contract checker guards against)."""
-    if axis_name is not None:
-        row_ptr = None
+    HYDRAGNN_PALLAS opt-in. Under an ``axis_name`` the global ``row_ptr``
+    offsets are wrong for a local edge shard: since graftmesh they are
+    LOCALIZED per shard (:func:`localize_row_ptr` — the caller passes its
+    local edge count) so graph-partitioned steps stay zero-searchsorted;
+    a caller that cannot name its local edge count falls back to the local
+    re-derivation (row_ptr nulled). Centralized so a routing change cannot
+    silently diverge between wrappers (a missed site would send that
+    wrapper's traffic back to the scatter path — the 0.47x regression class
+    the contract checker guards against)."""
+    if axis_name is not None and row_ptr is not None:
+        if num_local_edges is None:
+            row_ptr = None
+        else:
+            row_ptr = localize_row_ptr(row_ptr, axis_name, num_local_edges)
     use_sorted = sorted_ids and srt.sorted_enabled()
     use_csr_kernel = (
         not use_sorted
@@ -1229,10 +1259,10 @@ def fused_segment_sum_count(
     with masked rows targeting padding segments (whose outputs are unused) —
     the sorted path's count includes masked rows, which is only correct
     under that contract. ``row_ptr`` carries the contract's precomputed CSR
-    boundaries (ignored under ``axis_name``: local edge shards keep sorted
-    order but not the global offsets)."""
+    boundaries (LOCALIZED per shard under ``axis_name`` — graftmesh's
+    halo/edge-cut contract, see :func:`localize_row_ptr`)."""
     use_sorted, use_csr_kernel, row_ptr = _sorted_route(
-        sorted_ids, row_ptr, axis_name
+        sorted_ids, row_ptr, axis_name, num_local_edges=segment_ids.shape[0]
     )
     if use_sorted or use_csr_kernel:
         # Sorted/CSR contract prep: zero masked rows, RAW (sorted) ids.
@@ -1285,8 +1315,11 @@ def fused_segment_mean(
     """Drop-in masked ``segment_mean`` over the fused kernel (SAGE neighbor
     mean, the global mean-pool readout). Both paths return ``data.dtype`` so
     CPU-fallback and TPU runs agree on dtype flow."""
-    use_sorted, use_csr_kernel, row_ptr = _sorted_route(
-        sorted_ids, row_ptr, axis_name
+    # Route decision only — the UN-localized row_ptr forwards to
+    # fused_segment_sum_count, which performs the per-shard localization
+    # itself (localizing here too would shift the boundaries twice).
+    use_sorted, use_csr_kernel, _ = _sorted_route(
+        sorted_ids, row_ptr, axis_name, num_local_edges=segment_ids.shape[0]
     )
     if use_sorted or use_csr_kernel:
         total, count = fused_segment_sum_count(
@@ -1328,7 +1361,7 @@ def fused_segment_softmax(
     edge-only segment softmaxes; ``sorted_ids``/``row_ptr`` declare the CSR
     batch contract for the denominator sum."""
     use_sorted, use_csr_kernel, _ = _sorted_route(
-        sorted_ids, row_ptr, axis_name
+        sorted_ids, row_ptr, axis_name, num_local_edges=segment_ids.shape[0]
     )
     use_fast = pallas_enabled() or use_sorted or use_csr_kernel
     sum_fn = None

@@ -5,7 +5,23 @@ from .distributed import (
     get_local_size,
     init_comm_size_and_rank,
     make_mesh,
+    mesh_descriptor,
     parse_slurm_nodelist,
     resolve_coordinator_address,
     setup_ddp,
+)
+from .loopback import (
+    LoopbackError,
+    LoopbackRendezvous,
+    LoopbackWorker,
+    ProxyRendezvous,
+    loopback_train,
+    run_workers,
+)
+from .overlap import (
+    GRAD_SYNC_MODES,
+    overlap_fraction,
+    plan_buckets,
+    resolve_grad_sync,
+    ring_psum,
 )

@@ -236,6 +236,14 @@ def get_device_list():
     return jax.local_devices()
 
 
+def mesh_descriptor(mesh) -> str:
+    """Canonical axis-layout string of a mesh — ``"data:4xgraph:2"``. The
+    graftmesh CacheKey component (docs/COMPILE_CACHE.md): two shard_map
+    programs over different axis factorizations of the SAME device count
+    compile different collectives and must never hydrate each other."""
+    return "x".join(f"{name}:{int(size)}" for name, size in mesh.shape.items())
+
+
 def config_graph_axis(config: dict) -> int:
     """The JSON config's edge-sharding request — ``Training.graph_axis``
     (>1 shards each graph's edges over that many devices; absent/falsy means

@@ -297,9 +297,15 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
         compile_cache_fingerprint=compile_cache_fp,
         # graftprec (docs/PRECISION.md): Training.precision = "f32"|"bf16";
         # bf16 trains in bf16 compute against f32 master weights with dynamic
-        # loss scaling (Training.loss_scale block tunes it).
+        # loss scaling (Training.loss_scale block tunes it). Since graftmesh
+        # the policy also rides the mesh step (backoff lockstep post-psum).
         precision=training_cfg.get("precision"),
         loss_scale=training_cfg.get("loss_scale"),
+        # graftmesh (docs/DISTRIBUTED.md): Training.grad_sync selects the
+        # gradient-reduction arm of the mesh step ("single" | "bucketed" |
+        # "ring"); grad_bucket_mb sizes the overlap buckets.
+        grad_sync=training_cfg.get("grad_sync"),
+        grad_bucket_mb=training_cfg.get("grad_bucket_mb"),
     )
 
     # Visualizer gets the test set's input node features and graph sizes

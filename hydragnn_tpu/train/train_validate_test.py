@@ -85,6 +85,8 @@ class TrainingDriver:
         compile_cache_fingerprint: str = "",
         precision: Optional[str] = None,
         loss_scale: Optional[dict] = None,
+        grad_sync: Optional[str] = None,
+        grad_bucket_mb: Optional[float] = None,
     ):
         from ..faults import FaultPlan, StepGuard
 
@@ -152,6 +154,31 @@ class TrainingDriver:
             loss_scaling = self.precision.loss_scale
             self.precision_monitor = LossScaleMonitor(verbosity)
         guard = self.guard is not None
+        # graftmesh gradient-sync arm (Training.grad_sync, docs/
+        # DISTRIBUTED.md): "single" (default) is the historical one-psum
+        # step; "bucketed"/"ring" overlap per-bucket all-reduce with the
+        # backward. Resolved here so a bad knob fails at driver build, not
+        # mid-epoch inside a trace.
+        from ..parallel.overlap import DEFAULT_BUCKET_MB, resolve_grad_sync
+
+        self.grad_sync = resolve_grad_sync(grad_sync)
+        self.grad_bucket_mb = float(
+            grad_bucket_mb if grad_bucket_mb is not None else DEFAULT_BUCKET_MB
+        )
+        if self.grad_sync != "single" and mesh is None:
+            # The knob selects the MESH step's reduction arm; on a
+            # single-device driver it would be silently ignored — say so
+            # loudly (and below, keep it OUT of the cache flags so the
+            # compiled single-device program keeps its warm store entries).
+            import warnings
+
+            warnings.warn(
+                f"Training.grad_sync={self.grad_sync!r} has no effect "
+                "without a device mesh (single-device run) — the knob "
+                "selects the distributed step's gradient-reduction arm",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         if mesh is not None:
             # Each process stacks only its LOCAL slice of the data axis; the
             # stacked host-local array is lifted to a global jax.Array below —
@@ -165,6 +192,8 @@ class TrainingDriver:
             self.train_step = make_train_step_dp(
                 model, optimizer, mesh, donate, guard=guard,
                 loss_scaling=loss_scaling,
+                grad_sync=self.grad_sync,
+                grad_bucket_mb=self.grad_bucket_mb,
             )
             self.eval_step = make_eval_step_dp(model, mesh)
         else:
@@ -203,15 +232,18 @@ class TrainingDriver:
                 donate_argnums=(0,),
             )
         # Persistent compiled-executable store (graftcache, docs/
-        # COMPILE_CACHE.md): the single-device compiled steps (train_step /
-        # epoch_scan / perm_scan / eval_step) dispatch through the shared
-        # ExecutableRegistry — the same locked lookup→compile-outside-lock→
-        # store path the serve engine uses — so a crash-resumed or restarted
-        # run hydrates its 9.9 s of train compile from disk in well under a
-        # second. Opt-in (Training.compile_cache / HYDRAGNN_COMPILE_CACHE);
-        # disabled = the dispatch helper is a pass-through to the jit
-        # wrappers, byte-identical to the historical path. Mesh runs keep
-        # jit (shard_map AOT portability is not certified yet; ROADMAP 5).
+        # COMPILE_CACHE.md): ALL compiled steps — the single-device train_step
+        # / epoch_scan / perm_scan / eval_step AND the shard_map mesh steps
+        # (graftmesh) — dispatch through the shared ExecutableRegistry — the
+        # same locked lookup→compile-outside-lock→store path the serve engine
+        # uses — so a crash-resumed or restarted run hydrates its train
+        # compile from disk in well under a second. Mesh programs carry the
+        # mesh axis layout as a CacheKey component (a 4-device step must
+        # never hydrate a 2-device executable; the environment topology
+        # string already pins the device count). Opt-in
+        # (Training.compile_cache / HYDRAGNN_COMPILE_CACHE); disabled = the
+        # dispatch helper is a pass-through to the jit wrappers,
+        # byte-identical to the historical path.
         cache_dir = (
             compile_cache
             if compile_cache is not None
@@ -220,7 +252,12 @@ class TrainingDriver:
         self._exec_registry = None
         self._cache_fingerprint = ""
         self._cache_flags: tuple = ()
-        if cache_dir and mesh is None:
+        self._cache_mesh = ""
+        if mesh is not None:
+            from ..parallel.distributed import mesh_descriptor
+
+            self._cache_mesh = mesh_descriptor(mesh)
+        if cache_dir:
             import hashlib
 
             from ..cache import ExecutableRegistry, ExecutableStore
@@ -252,6 +289,19 @@ class TrainingDriver:
                 + (
                     (f"precision={self.precision.mode}",)
                     if self.precision is not None
+                    else ()
+                )
+                # The gradient-sync arm AND its bucket size change the
+                # compiled MESH program (plan_buckets groups leaves into
+                # different per-bucket collectives) without changing any tree
+                # shape; on a single-device driver the knob is inert and must
+                # not cool a warm store (byte-identical program, same key).
+                + (
+                    (
+                        f"grad_sync={self.grad_sync}"
+                        f":bucket_mb={self.grad_bucket_mb}",
+                    )
+                    if self.grad_sync != "single" and mesh is not None
                     else ()
                 )
             )
@@ -313,6 +363,7 @@ class TrainingDriver:
                 config_fingerprint=self._cache_fingerprint,
                 flags=self._cache_flags,
                 args_digest=tree_signature(args),
+                mesh=self._cache_mesh,
             ),
             lambda: fn.lower(*args),
         )

@@ -400,13 +400,146 @@ def _batch_pspec(batch: GraphBatch, graph_sharded: bool) -> GraphBatch:
         edge_mask=edge_spec,
         graph_mask=P("data"),
         targets=tuple(P("data") for _ in batch.targets),
-        # CSR boundaries are node-/graph-indexed (never edge-sharded; the ops
-        # layer ignores row_ptr under an axis_name — global edge offsets are
-        # wrong for a local shard).
+        # CSR boundaries are node-/graph-indexed (never edge-sharded;
+        # replicated across 'graph', where the ops layer LOCALIZES them per
+        # edge shard — pallas_segment.localize_row_ptr, the graftmesh
+        # halo/edge-cut contract — so graph-partitioned steps stay
+        # zero-searchsorted).
         row_ptr=None if batch.row_ptr is None else P("data"),
         graph_ptr=None if batch.graph_ptr is None else P("data"),
         num_graphs_pad=batch.num_graphs_pad,
     )
+
+
+def _dp_local_graftmesh(
+    model: HydraGNN,
+    optimizer,
+    guard: bool,
+    loss_scaling,
+    grad_sync: str,
+    grad_bucket_mb: float,
+    grad_axes,
+    data_axis_size: int,
+):
+    """The generalized per-shard DP body (graftmesh, docs/DISTRIBUTED.md):
+    selected whenever the step needs dynamic loss scaling and/or an
+    overlapped gradient-sync arm. The default single-psum unscaled path keeps
+    its historical body in ``make_train_step_dp`` byte-for-byte.
+
+    Overlapped arms (``grad_sync`` = "bucketed" | "ring") multiply the LOCAL
+    loss by ``count / max(psum(count), 1)`` before differentiation and let
+    the per-bucket backward hooks SUM cotangents across shards — identical
+    math to the single arm's weighted psum (the weight is constant w.r.t.
+    params), but each bucket's collective depends only on its own backward
+    segment, so it can overlap remaining backward compute.
+
+    With ``loss_scaling`` the scale state machine updates in LOCKSTEP after
+    the reduction: the all-finite flag is computed from the REDUCED loss and
+    gradients, so every shard sees the same overflow verdict and the
+    backoff/growth update applies identically everywhere (the property
+    tests/test_graftmesh.py pins: a NaN on one shard backs off all)."""
+    from ..parallel import overlap
+
+    scaled = loss_scaling is not None
+    if scaled:
+        from ..precision.policy import loss_scale_update
+    graph = "graph" in grad_axes
+
+    def body(state: TrainState, batch: GraphBatch, rng):
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        dropout_key = jax.random.fold_in(
+            rng, state.step * 1000 + jax.lax.axis_index("data")
+        )
+        ls = state.loss_scale
+        count = batch.count_real_graphs().astype(jnp.float32)
+        count_total = jax.lax.psum(count, "data")
+        denom = jnp.maximum(count_total, 1.0)
+        scale = ls.scale if scaled else jnp.float32(1.0)
+
+        if grad_sync == "single":
+            def fn(p):
+                loss, (bstats, rmses) = _loss_and_metrics(
+                    model, p, state.batch_stats, batch, dropout_key
+                )
+                return loss * scale, (loss, bstats, rmses)
+
+            (_, (loss, new_bstats, rmses)), sgrads = jax.value_and_grad(
+                fn, has_aux=True
+            )(state.params)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g * count, "data") / denom, sgrads
+            )
+            if graph:
+                grads = jax.lax.pmean(grads, "graph")
+        else:
+            w = count / denom
+            plan = overlap.plan_buckets(
+                state.params, grad_bucket_mb * (1 << 20)
+            )
+            reduce_fn = overlap.make_reduce(
+                grad_sync, grad_axes, data_axis_size
+            )
+
+            def fn(p):
+                ps = overlap.attach_grad_sync(p, plan, reduce_fn)
+                loss, (bstats, rmses) = _loss_and_metrics(
+                    model, ps, state.batch_stats, batch, dropout_key
+                )
+                return loss * scale * w, (loss, bstats, rmses)
+
+            # The bucket hooks already reduced these across shards.
+            (_, (loss, new_bstats, rmses)), grads = jax.value_and_grad(
+                fn, has_aux=True
+            )(state.params)
+        if scaled:
+            # Unscale AFTER the reduction in the grads' f32 master dtype —
+            # inf/NaN from an overflowed shard survives the psum and the
+            # divide, so the lockstep finite check below sees it everywhere.
+            inv = 1.0 / ls.scale
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        new_bstats = jax.tree_util.tree_map(
+            lambda s: jax.lax.psum(s * count, "data") / denom, new_bstats
+        )
+        if graph:
+            new_bstats = jax.lax.pmean(new_bstats, "graph")
+        loss_sum = jax.lax.psum(loss * count, "data")
+        rmses_sum = jax.lax.psum(rmses * count, "data")
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p + u, state.params, updates
+        )
+        metrics = {"loss": loss_sum, "rmses": rmses_sum, "count": count_total}
+        new_ls = ls
+        if scaled or guard:
+            # Post-reduction flag: every shard computes the SAME verdict from
+            # the reduced values, so skip/keep (and the scale update) apply
+            # in lockstep — no shard can diverge.
+            ok = _all_finite(loss_sum, grads)
+            new_params = _keep_if(ok, new_params, state.params)
+            new_opt = _keep_if(ok, new_opt, state.opt_state)
+            new_bstats = _keep_if(ok, new_bstats, state.batch_stats)
+            okf = ok.astype(jnp.float32)
+            metrics = {
+                "loss": jnp.where(ok, loss_sum, 0.0),
+                "rmses": jnp.where(ok, rmses_sum, jnp.zeros_like(rmses_sum)),
+                "count": count_total * okf,
+            }
+            if scaled:
+                new_ls, grew = loss_scale_update(ls, ok, loss_scaling)
+                metrics["overflow"] = 1.0 - okf
+                metrics["scale_growths"] = grew.astype(jnp.float32)
+            if guard:
+                metrics["bad"] = 1.0 - okf
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_bstats,
+            opt_state=new_opt,
+            step=state.step + 1,
+            loss_scale=new_ls,
+        )
+        return new_state, metrics
+
+    return body
 
 
 def make_train_step_dp(
@@ -416,15 +549,26 @@ def make_train_step_dp(
     donate: bool = True,
     guard: bool = False,
     loss_scaling=None,
+    grad_sync: str = "single",
+    grad_bucket_mb: float = 4.0,
 ) -> Callable:
     """SPMD step over a ('data', 'graph') mesh. ``batch`` arrays carry a leading
     device axis [D, ...] dealt over 'data'; when the model was built with
     graph_axis='graph' and the mesh has a nontrivial 'graph' axis, edges are
     additionally sharded over 'graph'. Grads are pmean'd over BOTH axes — with
     JAX's psum-transposes-to-psum rule this recovers the exact full gradient
-    (replicated node contributions stay unscaled, edge-shard contributions sum)."""
+    (replicated node contributions stay unscaled, edge-shard contributions sum).
+
+    ``grad_sync`` selects the gradient-reduction arm (graftmesh,
+    docs/DISTRIBUTED.md): "single" (default) reduces the whole tree in one
+    psum after the full backward — the historical step, byte-identical;
+    "bucketed" / "ring" dispatch per-bucket collectives as each backward
+    segment completes (``grad_bucket_mb`` sizes the buckets), overlapping
+    all-reduce with backward compute. ``loss_scaling`` arms the bf16 dynamic
+    loss-scale state machine with the backoff update in lockstep post-psum."""
     from jax.experimental.shard_map import shard_map
 
+    from ..parallel.overlap import resolve_grad_sync
     from ..utils.optimizer import ValueFnTransformation
 
     if isinstance(optimizer, ValueFnTransformation):
@@ -434,16 +578,15 @@ def make_train_step_dp(
             "across devices. Use a first-order optimizer (AdamW) for "
             "distributed runs, or LBFGS on a single device."
         )
-    if loss_scaling is not None:
-        raise NotImplementedError(
-            "Training.precision='bf16' (dynamic loss scaling) is not wired "
-            "into the mesh step yet: the scale state machine must update in "
-            "lockstep after the gradient psum (ROADMAP item 3 — lands with "
-            "the distributed-harness work of item 2). On a mesh, use "
-            "Architecture.compute_dtype='bfloat16' for compute-only bf16."
-        )
+    grad_sync = resolve_grad_sync(grad_sync)
     graph_sharded = model.graph_axis is not None and mesh.shape.get("graph", 1) > 1
     grad_axes = ("data", "graph") if graph_sharded else ("data",)
+    if loss_scaling is not None or grad_sync != "single":
+        _local = _dp_local_graftmesh(
+            model, optimizer, guard, loss_scaling, grad_sync,
+            float(grad_bucket_mb), grad_axes, int(mesh.shape["data"]),
+        )
+        return _wrap_dp_step(_local, mesh, graph_sharded, donate)
 
     def _local(state, batch, rng):
         # Inside shard_map the leading device axis is size 1: drop it.
@@ -505,6 +648,15 @@ def make_train_step_dp(
         )
         return new_state, metrics
 
+    return _wrap_dp_step(_local, mesh, graph_sharded, donate)
+
+
+def _wrap_dp_step(local, mesh, graph_sharded: bool, donate: bool):
+    """shard_map + jit wrapper shared by every DP train-step arm (one
+    definition so the graftmesh arms and the historical body can never
+    diverge in specs/donation/platform pinning)."""
+    from jax.experimental.shard_map import shard_map
+
     platform = _mesh_platform(mesh)
 
     def step(state, batch, rng):
@@ -512,7 +664,7 @@ def make_train_step_dp(
         # execution platform for the duration.
         with pallas_platform(platform):
             sharded = shard_map(
-                _local,
+                local,
                 mesh=mesh,
                 in_specs=(P(), _batch_pspec(batch, graph_sharded), P()),
                 out_specs=(P(), P()),

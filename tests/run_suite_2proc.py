@@ -18,7 +18,14 @@ thresholds), so a narrowed 2-process run is never plumbing-only — it always
 trains at least one real model data-parallel to convergence, mirroring the
 reference CI's ``mpirun -n 2`` coverage. Opt out with --no-convergence-cell.
 
-Exit code 0 iff both ranks pass.
+graftmesh (docs/DISTRIBUTED.md): on backends without cross-process
+collectives (XLA:CPU), the spawn arm is environmentally dead — the suite
+then RUNS the loopback-harness DP cells (2 logical workers, real 2-device
+virtual mesh) instead of skipping, and the exit code gates on THAT arm's
+verdict; the artifact records ``loopback`` + ``spawn_skipped``.
+
+Exit code 0 iff the distributed arm that ran passed (both ranks on capable
+backends; the loopback cells otherwise).
 """
 
 from __future__ import annotations
@@ -128,13 +135,55 @@ def main() -> int:
         sys.stdout.write(f.read())
     print(f"rank return codes: {rcs}; tests passed per rank: {ran}")
     skip_reason = None
+    loopback = None
     if backend_lacks_mp:
+        # graftmesh upgrade: the spawn arm is environmentally impossible on
+        # this backend, but that no longer means "skipped" — the REAL
+        # distributed run falls back to the loopback harness (2 logical
+        # workers, per-rank loader shards, shard_map DP over a 2-device
+        # virtual mesh; docs/DISTRIBUTED.md "Harness modes"): the loopback
+        # DP e2e cells from tests/test_multiprocess.py run to completion
+        # and the artifact records mode="loopback".
         skip_reason = (
-            "backend lacks multiprocess collectives (XLA: "
-            f"{no_mp_marker!r}) — 2-process suite is environmentally "
-            "impossible on this backend; see ROADMAP item 5"
+            "spawn arm skipped: backend lacks multiprocess collectives "
+            f"(XLA: {no_mp_marker!r}); ran the loopback harness arm instead"
         )
-        print(f"SKIPPED: {skip_reason}")
+        print(f"SPAWN ARM DEAD: {skip_reason}")
+        t_lb = time.time()
+        lb_env = dict(os.environ)
+        # The rank launches above pinned HYDRAGNN_HOST_DEVICES=1 semantics;
+        # the loopback arm needs a >1-device virtual topology regardless of
+        # what this process inherited — pin it explicitly.
+        lb_env["HYDRAGNN_HOST_DEVICES"] = "2"
+        lb_env.pop("OMPI_COMM_WORLD_SIZE", None)
+        lb_env.pop("OMPI_COMM_WORLD_RANK", None)
+        lb_proc = subprocess.run(
+            [
+                sys.executable, "-m", "pytest", "-q",
+                "-p", "no:cacheprovider",
+                "tests/test_multiprocess.py::pytest_two_worker_loopback_dp_training",
+                "tests/test_multiprocess.py::pytest_two_worker_loopback_overlap_arm_agrees",
+            ],
+            cwd=REPO,
+            env=lb_env,
+            capture_output=True,
+            text=True,
+        )
+        sys.stdout.write(lb_proc.stdout[-4000:])
+        m_lb = re.search(r"(\d+) passed", lb_proc.stdout)
+        loopback = {
+            "mode": "loopback",
+            "workers": 2,
+            "passed": int(m_lb.group(1)) if m_lb else 0,
+            "rc": lb_proc.returncode,
+            "seconds": round(time.time() - t_lb, 1),
+        }
+        print(f"LOOPBACK ARM: {loopback}")
+    ok = (
+        (loopback["rc"] == 0 and loopback["passed"] > 0)
+        if loopback is not None
+        else all(rc == 0 for rc in rcs) and all(n > 0 for n in ran)
+    )
     if artifact:
         import json
 
@@ -147,14 +196,17 @@ def main() -> int:
                     "seconds": elapsed,
                     "selection": extra,
                     "ranks": per_rank,
-                    "ok": all(rc == 0 for rc in rcs) and all(n > 0 for n in ran),
+                    "ok": ok,
                 }
-                | ({"skipped": skip_reason} if skip_reason else {}),
+                | ({"spawn_skipped": skip_reason} if skip_reason else {})
+                | ({"loopback": loopback} if loopback else {}),
                 f,
                 indent=2,
             )
-    if skip_reason is not None:
-        return 0
+    if loopback is not None:
+        # The loopback arm IS the distributed run on this backend: its
+        # verdict gates the exit code (no more unconditional-0 skip).
+        return 0 if ok else 1
     if not all(n > 0 for n in ran):
         # All-skipped still exits 0 from pytest; a selection outside the
         # multi-process-safe set must not read as a green distributed run.

@@ -336,3 +336,64 @@ def pytest_committed_precision_artifact_readable():
     assert blk is not None
     assert blk["convergence_ok"] is True
     assert blk["serve_arms_ok"] is True
+
+
+def pytest_last_known_multichip_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_multichip
+
+    real = {
+        "metric": "multichip_overlap_ab",
+        "value": 1.08,
+        "unit": "x_single_psum_vs_bucketed_step",
+        "devices": 8,
+        "overlap_fraction": {"bucketed": 0.41, "ring": 0.33},
+        "grads_allclose_ok": True,
+        "timings_meaningful": False,
+        "backend": "cpu",
+    }
+    (tmp_path / "MULTICHIP_r14.json").write_text(json.dumps(real))
+    # Pre-graftmesh dry-run smokes have no metric field — never "last known".
+    (tmp_path / "MULTICHIP_r05.json").write_text(
+        json.dumps({"n_devices": 8, "rc": 0, "ok": True})
+    )
+    # A failed round carries value 0.0 — also never "last known".
+    (tmp_path / "MULTICHIP_r15.json").write_text(
+        json.dumps({"metric": "multichip_overlap_ab", "value": 0.0})
+    )
+    now = time.time()
+    os.utime(tmp_path / "MULTICHIP_r14.json", (now - 50, now - 50))
+    os.utime(tmp_path / "MULTICHIP_r05.json", (now - 10, now - 10))
+    os.utime(tmp_path / "MULTICHIP_r15.json", (now - 5, now - 5))
+
+    blk = _last_known_multichip(str(tmp_path))
+    assert blk is not None
+    assert blk["value"] == 1.08
+    assert blk["overlap_fraction"]["bucketed"] == 0.41
+    assert blk["grads_allclose_ok"] is True
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "MULTICHIP_r14.json"
+
+
+def pytest_last_known_multichip_none_when_no_measurements(tmp_path):
+    from bench import _last_known_multichip
+
+    (tmp_path / "MULTICHIP_bad.json").write_text("{not json")
+    (tmp_path / "MULTICHIP_r05.json").write_text(
+        json.dumps({"n_devices": 8, "ok": True})
+    )
+    assert _last_known_multichip(str(tmp_path)) is None
+
+
+def pytest_committed_multichip_artifact_readable():
+    """The committed MULTICHIP_r* round is a valid last-known block with the
+    acceptance gates green (cross-arm grads allclose, overlap fraction
+    measured, CPU rounds labeled non-meaningful)."""
+    from bench import _last_known_multichip
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_multichip(repo)
+    assert blk is not None
+    assert blk["grads_allclose_ok"] is True
+    assert blk["overlap_fraction"]["bucketed"] is not None
+    if blk["backend"] == "cpu":
+        assert blk["timings_meaningful"] is False

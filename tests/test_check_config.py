@@ -306,3 +306,48 @@ def pytest_router_config_findings():
         },
         ladder=[(64, 256)],
     )
+
+
+def pytest_rejects_bad_mesh():
+    """graftmesh config contract (docs/DISTRIBUTED.md): unknown grad_sync
+    arm, non-positive bucket size, graph_axis with the CSR/sorted contract
+    explicitly off, unsatisfiable elastic worker range — and bf16+mesh is
+    now ACCEPTED (the loss-scale state machine rides the mesh step since
+    graftmesh; ROADMAP item 3's explicit rejection is closed)."""
+    e = _expect(
+        "bad-mesh",
+        lambda c: c["NeuralNetwork"]["Training"].update(grad_sync="overlap"),
+        deep=False,
+    )
+    assert "grad_sync" in str(e)
+    _expect(
+        "bad-mesh",
+        lambda c: c["NeuralNetwork"]["Training"].update(grad_bucket_mb=-1),
+        deep=False,
+    )
+    _expect(
+        "bad-mesh",
+        lambda c: c["NeuralNetwork"]["Training"].update(
+            elastic={"min_workers": 3, "max_workers": 1}
+        ),
+        deep=False,
+    )
+    os.environ["HYDRAGNN_SEGMENT_SORTED"] = "0"
+    try:
+        e = _expect(
+            "bad-mesh",
+            lambda c: c["NeuralNetwork"]["Training"].update(graph_axis=2),
+            deep=False,
+        )
+        assert "CSR" in str(e)
+    finally:
+        os.environ.pop("HYDRAGNN_SEGMENT_SORTED", None)
+    # bf16 + mesh: no finding (the old rejection class).
+    config = _base()
+    config["NeuralNetwork"]["Training"].update(
+        precision="bf16", graph_axis=2, grad_sync="bucketed"
+    )
+    report = check_config(config, mode="training", strict=False, deep=False)
+    assert not any(
+        e["code"] in ("bad-mesh", "bad-precision") for e in report["errors"]
+    ), report["errors"]

@@ -1,10 +1,19 @@
-"""2-process data-parallel integration test — the TPU-native analog of the
+"""2-worker data-parallel integration tests — the TPU-native analog of the
 reference CI's ``mpirun -n 2`` distributed pass (/root/reference/.github/
-workflows/CI.yml:47-52): two OS processes rendezvous through jax.distributed
-(the torch.distributed init_process_group analog), shard the dataset by
-process, psum gradients/metrics over the global mesh, and must agree on the
-globally-reduced loss (the reference never reduces eval metrics — we do,
-SURVEY.md §3.4)."""
+workflows/CI.yml:47-52), in TWO arms since graftmesh (docs/DISTRIBUTED.md):
+
+* LOOPBACK (REAL, tier-1): two logical workers on the in-process harness
+  (hydragnn_tpu/parallel/loopback.py) — per-rank loader shards, host
+  rendezvous, ONE shard_map DP step over a real 2-device virtual mesh, psum
+  gradient all-reduce — and every worker must report the same
+  globally-reduced loss. This arm runs on every backend; it replaced the
+  precise skip the 2-process path carried since PR 10.
+* SPAWN (the genuinely-multiprocess rendezvous arm): two OS processes
+  rendezvous through jax.distributed and train over the global mesh. On
+  backends without cross-process collectives (XLA:CPU raises "Multiprocess
+  computations aren't implemented") this arm keeps its PRECISE skip — the
+  capability is the backend's, not ours; the loopback arm carries the
+  distributed coverage there."""
 
 import json
 import os
@@ -102,7 +111,80 @@ def _launch_two_process(config, tmp_path, extra_env=None, timeout=420):
 
 
 @pytest.mark.mpi_skip
-def pytest_two_process_dp_training(tmp_path):
+def pytest_two_worker_loopback_dp_training(tmp_path, monkeypatch):
+    """REAL 2-worker DP e2e on the loopback harness (no skip): per-rank
+    loader shards, host rendezvous, shard_map step over a 2-device virtual
+    mesh — the assertions the env-dead spawn test carried: every worker
+    reports the SAME psum-reduced loss, and training makes progress."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    from hydragnn_tpu.parallel import loopback_train
+
+    with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    config["Visualization"] = {"create_plots": False}
+    _make_split_datasets(
+        config, tmp_path, {"train": 32, "test": 8, "validate": 8}
+    )
+    monkeypatch.setenv("SERIALIZED_DATA_PATH", str(tmp_path))
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        results = loopback_train(config, world_size=2)
+    finally:
+        os.chdir(cwd)
+    assert [r["rank"] for r in results] == [0, 1]
+    # Metrics are globally psum-reduced: every worker reports the SAME loss.
+    assert results[0]["final_loss"] == results[1]["final_loss"], results
+    for r in results:
+        hist = r["history"]["total_loss_train"]
+        assert all(float(x) == float(x) for x in hist)  # finite
+        assert hist[-1] < hist[0], hist
+        assert r["mesh"] == "data:2xgraph:1"
+
+
+@pytest.mark.mpi_skip
+def pytest_two_worker_loopback_overlap_arm_agrees(tmp_path, monkeypatch):
+    """The bucketed overlapped all-reduce rides the SAME loopback e2e and
+    lands within fp32 trajectory noise of the single-psum arm — the
+    end-to-end twin of test_graftmesh's step-level allclose gate."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    from hydragnn_tpu.parallel import loopback_train
+
+    with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    config["Visualization"] = {"create_plots": False}
+    _make_split_datasets(
+        config, tmp_path, {"train": 24, "test": 8, "validate": 8}
+    )
+    monkeypatch.setenv("SERIALIZED_DATA_PATH", str(tmp_path))
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        single = loopback_train(config, world_size=2, grad_sync="single")
+        bucketed = loopback_train(config, world_size=2, grad_sync="bucketed")
+    finally:
+        os.chdir(cwd)
+    assert bucketed[0]["final_loss"] == bucketed[1]["final_loss"]
+    assert single[0]["final_loss"] == pytest.approx(
+        bucketed[0]["final_loss"], rel=1e-4
+    )
+
+
+@pytest.mark.mpi_skip
+def pytest_two_process_rendezvous_arm(tmp_path):
+    """The genuinely-multiprocess arm: two OS processes rendezvous through
+    jax.distributed and train over the global mesh. Keeps its PRECISE skip
+    on backends without cross-process collectives (the loopback tests above
+    carry the distributed coverage there); on capable backends the old
+    assertions apply unchanged."""
     with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
         config = json.load(f)
     config["NeuralNetwork"]["Training"]["num_epoch"] = 3
@@ -129,11 +211,13 @@ def pytest_two_process_dp_training(tmp_path):
 
 
 @pytest.mark.mpi_skip
+@pytest.mark.slow
 def pytest_two_process_pna_convergence(tmp_path):
-    """Full PNA ci.json convergence under 2 processes with the UNCHANGED
-    single-process accuracy thresholds (reference CI runs its whole suite via
-    mpirun -n 2, /root/reference/.github/workflows/CI.yml:47-52) — thresholds
-    from tests/test_graphs.py THRESHOLDS['PNA']."""
+    """Full PNA ci.json convergence under 2 rendezvousing processes with the
+    UNCHANGED single-process accuracy thresholds (reference CI runs its whole
+    suite via mpirun -n 2, /root/reference/.github/workflows/CI.yml:47-52) —
+    thresholds from tests/test_graphs.py THRESHOLDS['PNA']. Spawn arm:
+    precise-skips where the backend lacks multiprocess collectives."""
     with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
         config = json.load(f)
     config["Visualization"] = {"create_plots": False}
