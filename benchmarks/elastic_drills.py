@@ -1,0 +1,369 @@
+"""graftelastic drill matrix — the measurement half of ``bench.py --elastic``
+(docs/DISTRIBUTED.md "Elastic runbook").
+
+Four drills over the elastic loopback harness
+(``hydragnn_tpu/parallel/elastic.py``), each a structural gate (CPU-
+meaningful — these are protocol properties, not timings):
+
+  kill_worker             a worker dies DIRTY mid-epoch: the world shrinks
+                          below the corpse and resumes from the last
+                          periodic checkpoint — the resumed (epoch, cursor)
+                          must be a checkpointed position (zero lost
+                          progress beyond the last checkpoint).
+  join_under_load         a clean leave then a join: the loader re-shards
+                          deterministically (per-epoch batch consumption is
+                          exactly-once), and the GROW transition's segment
+                          performs ZERO XLA compiles — the previously-seen
+                          topology's executable is reused through the shared
+                          registry (``warmup_xla_compiles=0``). The
+                          CROSS-PROCESS store-hydration claim is the
+                          warm-restart arm below (fresh jit caches, disk
+                          hydration only).
+  churn                   shrink → grow → shrink: the protocol survives
+                          repeated transitions with the conservation gate
+                          intact.
+  kill_during_transition  a transition dies AFTER its handoff checkpoint
+                          landed: the next incarnation restores the exact
+                          saved position (the atomic v2 install means state
+                          is never torn) and the run completes.
+
+Plus the convergence-parity gate: an elastic run (with a mid-epoch shrink)
+vs a fixed-world run of the same seed, final eval losses within the
+documented DP band from tests/test_graftmesh.py (1.5x + 0.02 — per-graph
+RMSE is not additive across shards). And a warm-restart arm: a SECOND
+trainer over the same graftcache store runs every segment with zero XLA
+compiles (fresh jit caches, disk hydration only).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DP_BAND_RATIO = 1.5
+DP_BAND_ABS = 0.02
+
+HEADS = {
+    "graph": {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 4,
+        "num_headlayers": 1,
+        "dim_headlayers": [4],
+    },
+}
+
+
+def _dataset(rng, count=24, lo=4, hi=12):
+    from hydragnn_tpu.graphs import GraphSample
+
+    graphs = []
+    for _ in range(count):
+        n = int(rng.integers(lo, hi))
+        x = rng.normal(size=(n, 1)).astype(np.float32)
+        ei = np.stack([np.arange(n), (np.arange(n) + 1) % n]).astype(np.int32)
+        graphs.append(
+            GraphSample(
+                x=x,
+                pos=np.zeros((n, 3), np.float32),
+                y=np.array([x.sum()], np.float32),
+                y_loc=np.array([[0, 1]], np.int64),
+                edge_index=ei,
+            )
+        )
+    return graphs
+
+
+def _build_trainer(
+    run_path: str,
+    seed: int = 0,
+    store: Optional[str] = None,
+    max_workers: int = 2,
+    heartbeat_s: float = 5.0,
+    checkpoint_every_steps: int = 2,
+    name: str = "elastic",
+):
+    from hydragnn_tpu.models import create_model
+    from hydragnn_tpu.parallel.elastic import ElasticConfig, ElasticTrainer
+    from hydragnn_tpu.preprocess.dataloader import GraphDataLoader
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    graphs = _dataset(np.random.default_rng(seed), count=24)
+    loader = GraphDataLoader(graphs, batch_size=4, shuffle=True, seed=seed)
+    loader.set_head_spec(("graph",), (1,))
+    model = create_model("SAGE", 1, 8, (1,), ("graph",), HEADS, [1.0], 2)
+    opt = select_optimizer("AdamW", 5e-3)
+    return ElasticTrainer(
+        model,
+        opt,
+        loader,
+        ElasticConfig(
+            min_workers=1, max_workers=max_workers, heartbeat_s=heartbeat_s
+        ),
+        run_path=run_path,
+        name=name,
+        compile_cache=store,
+        checkpoint_every_steps=checkpoint_every_steps,
+        seed=seed,
+    )
+
+
+# --------------------------------------------------------------------- drills
+def _drill_kill_worker(tmp: str, seed: int) -> Dict:
+    from hydragnn_tpu.parallel.elastic import ElasticEvent, ElasticSchedule
+
+    trainer = _build_trainer(os.path.join(tmp, "kill"), seed=seed)
+    report = trainer.run(
+        num_epochs=2,
+        start_world=2,
+        schedule=ElasticSchedule([ElasticEvent(step=3, kind="kill", worker="w1")]),
+    )
+    shrinks = [
+        t
+        for t in report["transitions"]
+        if t["kind"] == "shrink" and t["reason"] == "worker_death"
+    ]
+    resumed_at_checkpoint = all(
+        {"epoch": t["epoch"], "cursor": t["cursor"]}
+        in [{"epoch": s["epoch"], "cursor": s["cursor"]} for s in report["save_log"]]
+        for t in shrinks
+    )
+    ok = (
+        report["completed"]
+        and len(shrinks) == 1
+        and shrinks[0]["from_world"] == 2
+        and shrinks[0]["to_world"] == 1
+        and resumed_at_checkpoint
+        and report["epoch_conservation_ok"]
+        and np.isfinite(report["final_eval_loss"])
+    )
+    return {
+        "ok": bool(ok),
+        "transitions": report["transitions"],
+        "resumed_at_checkpointed_position": bool(resumed_at_checkpoint),
+        "epoch_conservation_ok": report["epoch_conservation_ok"],
+        "checkpoints_written": report["checkpoints_written"],
+        "final_eval_loss": report["final_eval_loss"],
+        "final_world": report["final_world"],
+    }
+
+
+def _drill_join_under_load(tmp: str, seed: int) -> Dict:
+    from hydragnn_tpu.parallel.elastic import ElasticEvent, ElasticSchedule
+
+    store = os.path.join(tmp, "join-store")
+    trainer = _build_trainer(os.path.join(tmp, "join"), seed=seed, store=store)
+    report = trainer.run(
+        num_epochs=2,
+        start_world=2,
+        schedule=ElasticSchedule(
+            [
+                ElasticEvent(step=2, kind="leave", worker="w1"),
+                ElasticEvent(step=5, kind="join"),
+            ]
+        ),
+    )
+    grows = [t for t in report["transitions"] if t["kind"] == "grow"]
+    # The segment AFTER the grow runs at the previously-seen world size: its
+    # executable must come back with zero fresh XLA compiles (in-run this is
+    # the shared registry's in-memory entry; the disk-hydration half of the
+    # claim is _warm_restart_gate, which starts from fresh jit caches).
+    w2_segments = [s for s in report["segment_log"] if s["world"] == 2]
+    post_grow_compiles = (
+        w2_segments[-1]["compiles"] if len(w2_segments) >= 2 else None
+    )
+    ok = (
+        report["completed"]
+        and len(grows) == 1
+        and grows[0]["from_world"] == 1
+        and grows[0]["to_world"] == 2
+        and post_grow_compiles == 0
+        and report["epoch_conservation_ok"]
+        and np.isfinite(report["final_eval_loss"])
+    )
+    return {
+        "ok": bool(ok),
+        "transitions": report["transitions"],
+        "warmup_xla_compiles": post_grow_compiles,
+        "segment_log": report["segment_log"],
+        "epoch_conservation_ok": report["epoch_conservation_ok"],
+        "final_eval_loss": report["final_eval_loss"],
+        "store": True,
+    }
+
+
+def _drill_churn(tmp: str, seed: int) -> Dict:
+    from hydragnn_tpu.parallel.elastic import ElasticEvent, ElasticSchedule
+
+    trainer = _build_trainer(os.path.join(tmp, "churn"), seed=seed)
+    report = trainer.run(
+        num_epochs=3,
+        start_world=2,
+        schedule=ElasticSchedule(
+            [
+                ElasticEvent(step=2, kind="leave", worker="w1"),
+                ElasticEvent(step=5, kind="join"),
+                ElasticEvent(step=9, kind="kill", worker="j1"),
+            ]
+        ),
+    )
+    kinds = [t["kind"] for t in report["transitions"]]
+    ok = (
+        report["completed"]
+        and kinds.count("shrink") >= 2
+        and kinds.count("grow") >= 1
+        and report["epoch_conservation_ok"]
+        and np.isfinite(report["final_eval_loss"])
+    )
+    return {
+        "ok": bool(ok),
+        "transition_kinds": kinds,
+        "transitions": report["transitions"],
+        "epoch_conservation_ok": report["epoch_conservation_ok"],
+        "final_eval_loss": report["final_eval_loss"],
+    }
+
+
+def _drill_kill_during_transition(tmp: str, seed: int) -> Dict:
+    from hydragnn_tpu.parallel.elastic import ElasticEvent, ElasticSchedule
+
+    trainer = _build_trainer(os.path.join(tmp, "killtr"), seed=seed)
+    report = trainer.run(
+        num_epochs=2,
+        start_world=2,
+        schedule=ElasticSchedule(
+            [
+                ElasticEvent(step=3, kind="leave", worker="w1"),
+                ElasticEvent(step=3, kind="kill_transition"),
+            ]
+        ),
+    )
+    shrinks = [t for t in report["transitions"] if t["kind"] == "shrink"]
+    # The retried (incarnation-1) transition must resume at the handoff
+    # checkpoint's exact position — the atomic save means never-torn state.
+    untorn = bool(shrinks) and all(
+        {"epoch": t["epoch"], "cursor": t["cursor"]}
+        in [{"epoch": s["epoch"], "cursor": s["cursor"]} for s in report["save_log"]]
+        for t in shrinks
+    )
+    ok = (
+        report["completed"]
+        and report["incarnations"] == 1
+        and bool(shrinks)
+        and shrinks[0]["incarnation"] == 1
+        and untorn
+        and report["epoch_conservation_ok"]
+        and np.isfinite(report["final_eval_loss"])
+    )
+    return {
+        "ok": bool(ok),
+        "incarnations": report["incarnations"],
+        "state_untorn": untorn,
+        "transitions": report["transitions"],
+        "epoch_conservation_ok": report["epoch_conservation_ok"],
+        "final_eval_loss": report["final_eval_loss"],
+    }
+
+
+def _parity_gate(tmp: str, seed: int) -> Dict:
+    """Step-matched same-seed convergence parity across a world-size
+    transition: the kill-drill trajectory vs a fixed-world run of the same
+    seed, final eval losses within the documented DP band
+    (tests/test_graftmesh.py — ratio 1.5x + 0.02 absolute)."""
+    from hydragnn_tpu.parallel.elastic import ElasticEvent, ElasticSchedule
+
+    elastic = _build_trainer(os.path.join(tmp, "par-el"), seed=seed)
+    el_report = elastic.run(
+        num_epochs=2,
+        start_world=2,
+        schedule=ElasticSchedule([ElasticEvent(step=3, kind="kill", worker="w1")]),
+    )
+    fixed = _build_trainer(os.path.join(tmp, "par-fx"), seed=seed)
+    fx_report = fixed.run(num_epochs=2, start_world=2)
+    el, fx = el_report["final_eval_loss"], fx_report["final_eval_loss"]
+    in_band = (
+        np.isfinite(el)
+        and np.isfinite(fx)
+        and el <= DP_BAND_RATIO * fx + DP_BAND_ABS
+        and fx <= DP_BAND_RATIO * el + DP_BAND_ABS
+    )
+    return {
+        "ok": bool(in_band),
+        "elastic_final_eval_loss": el,
+        "fixed_final_eval_loss": fx,
+        "band": f"{DP_BAND_RATIO}x + {DP_BAND_ABS}",
+        "elastic_transitions": len(el_report["transitions"]),
+    }
+
+
+def _warm_restart_gate(tmp: str, seed: int) -> Dict:
+    """Second-trainer-over-one-store arm: fresh jit caches, every segment
+    hydrates its mesh executable from the shared graftcache store — zero
+    XLA compiles across all TRAIN segments (model init and the final eval
+    probe compile legitimately and are outside the segment windows)."""
+    store = os.path.join(tmp, "warm-store")
+    cold = _build_trainer(
+        os.path.join(tmp, "warm-a"), seed=seed, store=store, name="warma"
+    )
+    cold_report = cold.run(num_epochs=1, start_world=2)
+    warm = _build_trainer(
+        os.path.join(tmp, "warm-b"), seed=seed, store=store, name="warmb"
+    )
+    warm_report = warm.run(num_epochs=1, start_world=2)
+    warm_compiles = sum(s["compiles"] for s in warm_report["segment_log"])
+    return {
+        "ok": bool(warm_compiles == 0 and warm_report["completed"]),
+        "cold_segment_compiles": sum(
+            s["compiles"] for s in cold_report["segment_log"]
+        ),
+        "warm_segment_compiles": warm_compiles,
+        "losses_match": bool(
+            abs(
+                cold_report["final_eval_loss"] - warm_report["final_eval_loss"]
+            )
+            < 1e-6
+        ),
+    }
+
+
+def run_elastic_drills(seed: int = 0) -> Dict:
+    drills: Dict[str, Dict] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        drills["kill_worker"] = _drill_kill_worker(tmp, seed)
+        drills["join_under_load"] = _drill_join_under_load(tmp, seed)
+        drills["churn"] = _drill_churn(tmp, seed)
+        drills["kill_during_transition"] = _drill_kill_during_transition(
+            tmp, seed
+        )
+        parity = _parity_gate(tmp, seed)
+        warm = _warm_restart_gate(tmp, seed)
+    ok = all(d["ok"] for d in drills.values()) and parity["ok"] and warm["ok"]
+    return {
+        "ok": bool(ok),
+        "seed": int(seed),
+        "drills": drills,
+        "drills_passed": sum(1 for d in drills.values() if d["ok"]),
+        "drills_total": len(drills),
+        "convergence_parity": parity,
+        "warm_restart": warm,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    n = int(os.environ.get("HYDRAGNN_HOST_DEVICES", "8"))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}"
+    )
+    import jax
+
+    if os.environ.get("HYDRAGNN_TPU_TESTS") != "1":
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_elastic_drills(), indent=2))

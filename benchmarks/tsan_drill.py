@@ -316,6 +316,63 @@ def _mesh_drill() -> None:
         raise AssertionError("loopback abort path did not surface the death")
 
 
+def _elastic_drill() -> None:
+    """graftelastic path (ISSUE 15): the membership tracker hammered by N
+    heartbeat threads racing the coordinator's drain/poll loop, the
+    rendezvous one-way mailbox post/drain races under the instrumented
+    LoopbackRendezvous._lock, and the drill schedule consulted from worker
+    and leader sides — MembershipTracker._lock / ElasticSchedule._lock
+    registered here from day one per the PR-8 rule. The yield site
+    ``elastic.membership.heartbeat`` perturbs the beat-vs-poll window."""
+    import threading
+
+    from hydragnn_tpu.parallel import LoopbackRendezvous
+    from hydragnn_tpu.parallel.elastic import (
+        ElasticEvent,
+        ElasticSchedule,
+        MembershipTracker,
+    )
+
+    tracker = MembershipTracker(heartbeat_s=60.0)
+    rdv = LoopbackRendezvous(4)
+    sched = ElasticSchedule(
+        [
+            ElasticEvent(step=5, kind="leave", worker="hb1"),
+            ElasticEvent(step=7, kind="join", worker="jx"),
+            ElasticEvent(step=9, kind="kill", worker="hb2"),
+        ]
+    )
+
+    def beat(wid: str, rank: int) -> None:
+        tracker.join(wid)
+        for i in range(24):
+            tracker.heartbeat(wid)
+            rdv.post(rank, {"wid": wid}, tag="heartbeat")
+            sched.kill_due(wid, i)
+
+    threads = [
+        threading.Thread(
+            target=beat, args=(f"hb{r}", r),
+            name=f"elastic-beat-{r}", daemon=True,
+        )
+        for r in range(4)
+    ]
+    for t in threads:
+        t.start()
+    expected = [f"hb{r}" for r in range(4)]
+    for step in range(24):
+        tracker.drain(rdv.posts("heartbeat"))
+        sched.control_events(step)
+        sched.transition_kill_due(step)
+        tracker.poll(expected)
+    for t in threads:
+        t.join(60)
+    tracker.drain(rdv.posts("heartbeat"))
+    tracker.mark_dead("hb3")
+    change = tracker.poll(expected)
+    assert "hb3" in change.dead, change
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -327,6 +384,7 @@ def run_drill(seed: int) -> dict:
         _route_drill()
         _swap_drill(tmpdir)
         _mesh_drill()
+        _elastic_drill()
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

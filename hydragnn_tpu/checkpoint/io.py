@@ -587,6 +587,100 @@ def load_checkpoint_meta(model_name: str, path: str = "./logs/") -> Dict[str, An
     return payload.get("meta") or {}
 
 
+# -------------------------------------------------- elastic world handoff
+# (graftelastic, docs/DISTRIBUTED.md "Elastic runbook"): a checkpoint written
+# at world size N must restore at world size M. The payload side is already
+# world-independent by construction — params/opt_state are replicated, the
+# param-tree fingerprint has no world component — so the handoff contract
+# lives entirely in the meta block these helpers write and verify.
+
+ELASTIC_META_KEY = "elastic"
+
+
+def elastic_handoff_meta(
+    world_size: int,
+    epoch: int,
+    cursor: int,
+    incarnation: int,
+    global_step: int,
+    num_batches: int,
+) -> Dict[str, Any]:
+    """The meta block an elastic save carries: the GLOBAL epoch cursor (which
+    batch of the epoch's world-independent plan to resume at), the world the
+    save happened under (diagnostic only — never a restore constraint), and
+    the incarnation/step counters the drills assert on."""
+    return {
+        "world_size": int(world_size),
+        "epoch": int(epoch),
+        "cursor": int(cursor),
+        "incarnation": int(incarnation),
+        "global_step": int(global_step),
+        "num_batches": int(num_batches),
+    }
+
+
+def verify_elastic_handoff(
+    meta: Dict[str, Any],
+    new_world: int,
+    min_workers: int = 1,
+    max_workers: Optional[int] = None,
+) -> Dict[str, Any]:
+    """World-size-independent handoff assertions, run at every elastic
+    restore: the NEW world must satisfy the configured range, and the
+    checkpoint's elastic block (when present) must carry a coherent resume
+    position. A checkpoint without the block (a plain periodic save) hands
+    off at the epoch boundary — ``cursor`` 0 — which is exactly the
+    pre-elastic resume contract. Raises :class:`CheckpointError` naming both
+    worlds on a violation; returns the resume descriptor
+    ``{epoch, cursor, world_size, global_step}``."""
+    new_world = int(new_world)
+    if new_world < 1:
+        raise CheckpointError(
+            f"elastic handoff: new world size {new_world} is not a positive "
+            "worker count"
+        )
+    if new_world < int(min_workers) or (
+        max_workers is not None and new_world > int(max_workers)
+    ):
+        raise CheckpointError(
+            f"elastic handoff: new world size {new_world} outside the "
+            f"configured range [{min_workers}, {max_workers}]"
+        )
+    block = (meta or {}).get(ELASTIC_META_KEY)
+    if not block:
+        return {
+            "epoch": int((meta or {}).get("epoch") or 0),
+            "cursor": 0,
+            "world_size": None,
+            "global_step": None,
+        }
+    try:
+        epoch = int(block["epoch"])
+        cursor = int(block["cursor"])
+        saved_world = int(block["world_size"])
+        num_batches = int(block.get("num_batches", 0))
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointError(
+            f"elastic handoff: checkpoint elastic block is malformed "
+            f"({e!r}) — saved under world_size="
+            f"{(block or {}).get('world_size')!r}, restoring at world_size="
+            f"{new_world}"
+        ) from e
+    if epoch < 0 or cursor < 0 or (num_batches and cursor > num_batches):
+        raise CheckpointError(
+            f"elastic handoff: resume position epoch={epoch} cursor={cursor} "
+            f"(of {num_batches} batches) is incoherent — checkpoint saved "
+            f"under world_size={saved_world}, restoring at world_size="
+            f"{new_world}"
+        )
+    return {
+        "epoch": epoch,
+        "cursor": cursor,
+        "world_size": saved_world,
+        "global_step": block.get("global_step"),
+    }
+
+
 # ------------------------------------------------------- migration utilities
 
 

@@ -35,6 +35,10 @@ from .counters import FaultCounters
 from .plan import RESTART_ENV_VAR
 
 SUPERVISOR_META = "supervisor.json"
+# graftelastic (docs/DISTRIBUTED.md "Elastic runbook"): the coordinator
+# address an elastic supervisor exports to its children; the training epoch
+# loop posts liveness beats to it (train/train_validate_test.py).
+ELASTIC_COORD_ENV_VAR = "HYDRAGNN_ELASTIC_COORD"
 
 
 def _atomic_write_json(path: str, doc: dict) -> None:
@@ -87,6 +91,82 @@ def read_supervisor_meta(log_name: str, path: str = "./logs/") -> dict:
         return json.load(f)
 
 
+def record_elastic_transition(
+    log_name: str, transition: dict, path: str = "./logs/"
+) -> None:
+    """Persist an elastic world transition into ``supervisor.json`` — the
+    `mesh` block must always describe the topology the run LAST trained
+    under, whoever observed the change (the supervisor's restart loop, or a
+    STANDALONE resume that check_restart_topology admitted — without this, a
+    manual resume at a changed world would leave the metadata stale and a
+    post-mortem reading it would reconstruct the wrong history). Atomic
+    read-modify-write; rank-0 callers only."""
+    meta_path = os.path.join(path, log_name, SUPERVISOR_META)
+    try:
+        with open(meta_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc.setdefault("elastic_transitions", []).append(transition)
+    doc.setdefault("mesh", {})["world_size"] = int(transition["to_world"])
+    _atomic_write_json(meta_path, doc)
+
+
+def _monitored_child_run(
+    cmd, env, tracker, coordinator, heartbeat_s: float
+):
+    """Run one child incarnation under the elastic membership loop: drain
+    heartbeat posts from the coordinator mailbox into the tracker while the
+    child lives, and — once the child has proven it CAN beat — treat silence
+    past ``heartbeat_s`` as a hang: terminate it so the restart loop can act
+    (a wedged child is as dead as a killed one, it just doesn't know it).
+    Returns ``(returncode, heartbeats, stalled)``."""
+    # Discard beats a dying previous incarnation left in the mailbox (its
+    # final poll window): a stale beat must not "prove" the FRESH child can
+    # beat and arm the hang-kill against it mid-startup.
+    coordinator.posts("heartbeat")
+    proc = subprocess.Popen(cmd, env=env)
+    beats = 0
+    last_beat: Optional[float] = None
+    stalled = False
+    try:
+        while True:
+            posts = coordinator.posts("heartbeat")
+            tracker.drain(posts)
+            # Only THIS child's beats arm/feed the hang-kill deadline: a dead
+            # predecessor's in-flight post landing after the pre-spawn
+            # discard must not "prove" the fresh child can beat while it is
+            # still compiling (the beat payload carries the sender's pid).
+            n = sum(
+                1
+                for _rank, p in posts
+                if isinstance(p, dict) and p.get("pid") == proc.pid
+            )
+            if n:
+                beats += n
+                last_beat = time.monotonic()
+            rc = proc.poll()
+            if rc is not None:
+                return rc, beats, stalled
+            if (
+                not stalled
+                and last_beat is not None
+                and time.monotonic() - last_beat > heartbeat_s
+            ):
+                stalled = True
+                FaultCounters.inc("elastic_stall_kills")
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:  # never leak a child past the supervisor
+            proc.kill()
+            proc.wait()
+
+
 def run_supervised(
     config,
     max_restarts: int = 3,
@@ -101,6 +181,16 @@ def run_supervised(
     signal) consumes one restart; the next child resumes from the run's last
     periodic checkpoint. Exhausting ``max_restarts`` raises, with the full
     attempt log in the metadata file.
+
+    With ``Training.elastic`` configured the supervisor additionally runs the
+    graftelastic membership loop (docs/DISTRIBUTED.md "Elastic runbook"): a
+    ``ProxyRendezvous`` coordinator whose address children receive via
+    ``HYDRAGNN_ELASTIC_COORD`` (the epoch loop posts liveness beats), a
+    hang-kill deadline of ``heartbeat_s`` once a child has proven it beats,
+    and restart-with-new-world — each incarnation re-reads the scheduler env
+    and a world-size change within ``[min_workers, max_workers]`` is recorded
+    as an elastic transition (the child re-shards and resumes); outside the
+    range, the supervisor fails loudly naming both worlds.
     """
     from ..utils.config_utils import get_log_name_config
     from ..utils.model import cleanup_stale_checkpoint_tmp
@@ -140,72 +230,142 @@ def run_supervised(
         },
     }
     meta_path = os.path.join(run_dir, SUPERVISOR_META)
+    # graftelastic membership loop (docs/DISTRIBUTED.md "Elastic runbook"):
+    # only armed when Training.elastic is configured — the plain supervisor
+    # keeps its historical subprocess.run path byte-for-byte.
+    from ..parallel.elastic import ElasticConfig
+
+    elastic_cfg = ElasticConfig.from_training(training_cfg)
+    coordinator = None
+    tracker = None
+    coord_addr = None
+    if elastic_cfg is not None:
+        from ..parallel.elastic import MembershipTracker
+        from ..parallel.loopback import ProxyRendezvous
+
+        meta["elastic_transitions"] = []
+        coordinator = ProxyRendezvous(world_size=max(1, world_size))
+        coord_addr = f"127.0.0.1:{coordinator.serve()}"
+        tracker = MembershipTracker(elastic_cfg.heartbeat_s)
     # Children import hydragnn_tpu by module path regardless of the run's
     # cwd (training runs chdir'd into scratch dirs are the norm in tests).
     pkg_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     attempt = 0
-    while True:
-        env = dict(os.environ)
-        env[RESTART_ENV_VAR] = str(attempt)
-        env["PYTHONPATH"] = pkg_root + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-        )
-        if extra_env:
-            env.update(extra_env)
-        t0 = time.time()
-        proc = subprocess.run(
-            [
+    try:
+        while True:
+            # Restart-with-new-world: each incarnation re-reads the scheduler
+            # env. A changed world size is an elastic transition when
+            # Training.elastic admits it (the child re-shards its loader and
+            # rebuilds its mesh at the new size, resuming from the last
+            # periodic checkpoint); otherwise it is a topology contradiction
+            # and the supervisor fails LOUDLY naming both worlds — ONE
+            # admission rule (check_restart_topology) shared with the
+            # resuming child, so the two can never disagree on legality.
+            from ..parallel.elastic import check_restart_topology
+
+            cur_world, _ = init_comm_size_and_rank()
+            try:
+                transition = check_restart_topology(
+                    meta["mesh"],
+                    cur_world,
+                    meta["mesh"].get("graph_axis", 1),
+                    elastic_cfg,
+                )
+            except RuntimeError as e:
+                _write_meta(meta_path, meta)
+                raise RuntimeError(
+                    f"supervised restart (attempt {attempt}): {e}"
+                ) from e
+            if transition is not None:
+                transition = dict(transition, attempt=attempt)
+                meta.setdefault("elastic_transitions", []).append(transition)
+                meta["mesh"]["world_size"] = cur_world
+                # Persist BEFORE the child spawns: the resuming incarnation
+                # consumes this block — it must see the post-transition
+                # world (and not re-record the same transition itself).
+                meta = _write_meta(meta_path, meta)
+                from ..telemetry import graftel as telemetry
+
+                telemetry.event("elastic/supervisor_transition", **transition)
+            env = dict(os.environ)
+            env[RESTART_ENV_VAR] = str(attempt)
+            env["PYTHONPATH"] = pkg_root + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            )
+            if coord_addr is not None:
+                env[ELASTIC_COORD_ENV_VAR] = coord_addr
+                # The child's pump thread beats at heartbeat_s/4 — liveness
+                # never depends on epoch cadence.
+                env["HYDRAGNN_ELASTIC_HEARTBEAT_S"] = str(
+                    elastic_cfg.heartbeat_s
+                )
+            if extra_env:
+                env.update(extra_env)
+            cmd = [
                 python or sys.executable,
                 "-m",
                 "hydragnn_tpu.faults.supervisor",
                 "--child",
                 cfg_path,
-            ],
-            env=env,
-        )
-        meta["attempts"].append(
-            {
+            ]
+            t0 = time.time()
+            if tracker is not None:
+                returncode, heartbeats, stalled = _monitored_child_run(
+                    cmd, env, tracker, coordinator, elastic_cfg.heartbeat_s
+                )
+            else:
+                returncode = subprocess.run(cmd, env=env).returncode
+                heartbeats, stalled = None, None
+            record = {
                 "attempt": attempt,
-                "returncode": proc.returncode,
+                "returncode": returncode,
                 "duration_s": round(time.time() - t0, 3),
                 "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             }
-        )
-        if proc.returncode == 0:
-            meta["completed"] = True
-            return _write_meta(meta_path, meta)
-        if attempt >= max_restarts:
-            _write_meta(meta_path, meta)
-            raise RuntimeError(
-                f"supervised training failed after {attempt} restart(s) "
-                f"(max_restarts={max_restarts}); attempt log: {meta_path}"
-            )
-        attempt += 1
-        meta["restarts"] = attempt
-        FaultCounters.inc("restarts")
-        # Flight-recorder trigger (docs/OBSERVABILITY.md): the supervisor's
-        # own timeline (attempt events, fault counters) at each child death —
-        # dumped into the run dir next to supervisor.json so "why did it
-        # restart" and "what restarted" live side by side.
-        from ..telemetry import graftel as telemetry
+            if tracker is not None:
+                record["world_size"] = meta["mesh"]["world_size"]
+                record["heartbeats"] = heartbeats
+                record["stalled"] = stalled
+            meta["attempts"].append(record)
+            if returncode == 0:
+                meta["completed"] = True
+                return _write_meta(meta_path, meta)
+            if attempt >= max_restarts:
+                _write_meta(meta_path, meta)
+                raise RuntimeError(
+                    f"supervised training failed after {attempt} restart(s) "
+                    f"(max_restarts={max_restarts}); attempt log: {meta_path}"
+                )
+            attempt += 1
+            meta["restarts"] = attempt
+            FaultCounters.inc("restarts")
+            # Flight-recorder trigger (docs/OBSERVABILITY.md): the
+            # supervisor's own timeline (attempt events, fault counters) at
+            # each child death — dumped into the run dir next to
+            # supervisor.json so "why did it restart" and "what restarted"
+            # live side by side.
+            from ..telemetry import graftel as telemetry
 
-        telemetry.event(
-            "fault/supervisor_restart",
-            attempt=attempt,
-            returncode=meta["attempts"][-1]["returncode"],
-        )
-        telemetry.flight_dump(
-            "supervisor_restart",
-            run_dir=run_dir,
-            extra={
-                "attempt": attempt,
-                "returncode": meta["attempts"][-1]["returncode"],
-                "max_restarts": int(max_restarts),
-            },
-        )
-        meta = _write_meta(meta_path, meta)
+            telemetry.event(
+                "fault/supervisor_restart",
+                attempt=attempt,
+                returncode=meta["attempts"][-1]["returncode"],
+            )
+            telemetry.flight_dump(
+                "supervisor_restart",
+                run_dir=run_dir,
+                extra={
+                    "attempt": attempt,
+                    "returncode": meta["attempts"][-1]["returncode"],
+                    "max_restarts": int(max_restarts),
+                },
+            )
+            meta = _write_meta(meta_path, meta)
+    finally:
+        if coordinator is not None:
+            coordinator.close()
 
 
 def main(argv=None) -> int:

@@ -67,33 +67,132 @@ class LifecycleManager:
         self.router = router
 
     # ---------------------------------------------------------------- helpers
-    def _template(self) -> Dict[str, Any]:
-        """Variables template for verified loads: the first engine's weight
-        structure (flax ``from_bytes`` restores onto it; values ignored).
-        For quantized arms the engine's f32 reference is the honest
-        template — the served params carry the same tree either way."""
-        engine = self.engines[0]
-        ref = getattr(engine, "_ref_variables", None)
-        if ref is not None:
-            return ref
-        params, bstats, _v = engine._current_weights()
-        return {"params": params, "batch_stats": bstats}
+    @staticmethod
+    def _is_engine(target: Any) -> bool:
+        """In-process engines are driven by ``swap_weights(variables, ...)``;
+        anything else (an ``HttpReplica``, a spawned fleet member) is driven
+        by ``swap_checkpoint(path, ...)`` — the /swap admin endpoint, which
+        re-verifies the staged identity server-side."""
+        return hasattr(target, "swap_weights")
 
-    def _swap_all(self, variables: Dict[str, Any], version: ModelVersion) -> float:
-        """Swap every engine, or none: a failure on replica k (worker death,
-        per-engine gate refusal) republishes the pre-swap weights on
-        replicas 0..k-1 before re-raising — the fleet is never left
-        version-torn against a role table that did not flip."""
+    def _inproc_engines(self) -> List[Any]:
+        return [t for t in self.engines if self._is_engine(t)]
+
+    def _template(self) -> Dict[str, Any]:
+        """Variables template for verified loads — the first in-process
+        engine's ``variables_template()`` (one definition with the /swap
+        path, serve/engine.py). Pure-HTTP fleets never call this: their
+        verified load happens replica-side through /swap's identity check."""
+        return self._inproc_engines()[0].variables_template()
+
+    def _load_role(self, role: str):
+        """(variables, meta, loaded_version) for the role. With at least one
+        in-process engine, the registry's verified template load runs here;
+        a pure path-driven fleet instead re-verifies the role file's content
+        identity (each replica's /swap verifies it AGAIN against the bytes
+        it actually loads — ``expected_identity`` below)."""
+        if self._inproc_engines():
+            return self.registry.load_role(role, self._template())
+        from ..checkpoint.format import file_content_identity
+        from .registry import CandidateVerificationError
+
+        mv = getattr(self.registry, role)
+        if mv is None:
+            raise LifecycleError(f"no version holds the {role!r} role")
+        identity, _details = file_content_identity(mv.path)
+        if identity != mv.version:
+            raise CandidateVerificationError(
+                f"{role} file {mv.file} no longer verifies as "
+                f"{mv.short} (found {identity[:12]})",
+                loaded_version=identity,
+            )
+        return None, {"epoch": mv.epoch}, mv
+
+    def _swap_one(
+        self, target: Any, variables: Optional[Dict[str, Any]], version: ModelVersion
+    ) -> None:
+        if self._is_engine(target):
+            assert variables is not None  # guaranteed by _load_role
+            target.swap_weights(variables, version.short)
+        else:
+            target.swap_checkpoint(
+                version.path,
+                version=version.short,
+                expected_identity=version.version,
+            )
+
+    def _capture(self, target: Any):
+        """Pre-swap restore point: the engine's weight triple in-process,
+        the registry's CURRENT live version (a re-swappable path) for
+        path-driven replicas."""
+        if self._is_engine(target):
+            return ("weights", target._current_weights())
+        return ("version", self.registry.live)
+
+    def _unwind_one(self, target: Any, captured) -> None:
+        kind, val = captured
+        if kind == "weights":
+            target.restore_weights(val)
+        elif val is not None:
+            target.swap_checkpoint(
+                val.path, version=val.short, expected_identity=val.version
+            )
+        else:
+            # First-ever promote on a path-driven replica: there is no
+            # previous version to restore — record it loudly; the replica
+            # serves the candidate until the operator intervenes.
+            telemetry.event(
+                "swap/unwind_impossible",
+                replica=getattr(target, "name", "?"),
+            )
+
+    def _unwind_fleet(self, targets, captured_states, version) -> None:
+        """Best-effort unwind of EVERY listed member: since unwinding a
+        path-driven replica is itself a fallible network call, one failing
+        member must not abort the rest (that would leave members torn AND
+        unlogged) nor mask the original error — each failure is swallowed
+        into a ``swap/unwind_failed`` event and the loop continues."""
+        for target, captured in zip(targets, captured_states):
+            try:
+                self._unwind_one(target, captured)
+            except Exception:
+                telemetry.event(
+                    "swap/unwind_failed",
+                    version=version.short,
+                    replica=getattr(target, "name", "?"),
+                )
+
+    def _swap_all(
+        self, variables: Optional[Dict[str, Any]], version: ModelVersion
+    ) -> float:
+        """Swap every fleet member, or none: a failure on replica k (worker
+        death, per-engine gate refusal, an HTTP replica's /swap refusal)
+        restores the pre-swap state on members 0..k-1 before re-raising —
+        the fleet is never left version-torn against a role table that did
+        not flip."""
         t0 = time.perf_counter()
-        previous = [engine._current_weights() for engine in self.engines]
+        previous = [self._capture(target) for target in self.engines]
         done = 0
         try:
-            for engine in self.engines:
-                engine.swap_weights(variables, version.short)
+            for target in self.engines:
+                self._swap_one(target, variables, version)
                 done += 1
         except BaseException:
-            for engine, weights in zip(self.engines[:done], previous[:done]):
-                engine.restore_weights(weights)
+            self._unwind_fleet(
+                self.engines[:done], previous[:done], version
+            )
+            # The member that FAILED may still have swapped server-side: an
+            # HTTP timeout or connection reset after the replica received
+            # /swap is client-ambiguous. Best-effort re-pin it to the
+            # pre-swap state so a torn fleet is a loudly-logged anomaly,
+            # never a silent one (in-process engines have exact exception
+            # semantics and need no such repair).
+            if done < len(self.engines) and not self._is_engine(
+                self.engines[done]
+            ):
+                self._unwind_fleet(
+                    [self.engines[done]], [previous[done]], version
+                )
             telemetry.event(
                 "swap/fleet_unwound", version=version.short, swapped=done
             )
@@ -148,11 +247,9 @@ class LifecycleManager:
                 f">= {gate.get('min_samples')} clean comparisons)",
                 report=gate,
             )
-        variables, meta, loaded = self.registry.load_role(
-            "candidate", self._template()
-        )
+        variables, meta, loaded = self._load_role("candidate")
         old_live = self.registry.live
-        previous_weights = [e._current_weights() for e in self.engines]
+        previous_state = [self._capture(e) for e in self.engines]
         wall = self._swap_all(variables, loaded)
         try:
             self.registry.commit_promote(loaded)
@@ -161,8 +258,7 @@ class LifecycleManager:
             # failed sidecar install): un-publish the already-swapped fleet
             # — engines must never serve a version the registry does not
             # record as live.
-            for engine, weights in zip(self.engines, previous_weights):
-                engine.restore_weights(weights)
+            self._unwind_fleet(self.engines, previous_state, loaded)
             telemetry.event("swap/fleet_unwound", version=loaded.short)
             raise
         if self.router is not None:
@@ -194,17 +290,14 @@ class LifecycleManager:
                 "needs checkpoint_keep_last_k >= 2 so the previous file "
                 "still exists; contracts.py flags bad-lifecycle otherwise)"
             )
-        variables, meta, loaded = self.registry.load_role(
-            "previous", self._template()
-        )
+        variables, meta, loaded = self._load_role("previous")
         old_live = self.registry.live
-        previous_weights = [e._current_weights() for e in self.engines]
+        previous_state = [self._capture(e) for e in self.engines]
         wall = self._swap_all(variables, loaded)
         try:
             self.registry.commit_rollback(loaded)
         except BaseException:
-            for engine, weights in zip(self.engines, previous_weights):
-                engine.restore_weights(weights)
+            self._unwind_fleet(self.engines, previous_state, loaded)
             telemetry.event("swap/fleet_unwound", version=loaded.short)
             raise
         report = {

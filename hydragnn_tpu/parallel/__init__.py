@@ -10,6 +10,20 @@ from .distributed import (
     resolve_coordinator_address,
     setup_ddp,
 )
+from .elastic import (
+    ElasticConfig,
+    ElasticError,
+    ElasticEvent,
+    ElasticSchedule,
+    ElasticTrainer,
+    MembershipChange,
+    MembershipTracker,
+    TransitionKilled,
+    WorkerKilled,
+    check_restart_topology,
+    shard_schedule,
+    shard_window,
+)
 from .loopback import (
     LoopbackError,
     LoopbackRendezvous,

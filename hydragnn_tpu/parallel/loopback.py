@@ -73,6 +73,11 @@ class LoopbackRendezvous:
         self._slots: List[Any] = [None] * world_size  # guarded-by: self._lock
         self._tags: List[Any] = [None] * world_size  # guarded-by: self._lock
         self._aborted = False  # guarded-by: self._lock, dirty-reads(monotonic bool; a stale False only delays the LoopbackError by one barrier)
+        # One-way mailbox (graftelastic, docs/DISTRIBUTED.md "Elastic
+        # runbook"): non-collective posts — heartbeats, join/leave
+        # announcements — that must NOT block on a barrier (a dead worker
+        # would wedge them forever). tag -> [(rank, payload), ...].
+        self._mailbox: dict = {}  # guarded-by: self._lock
         # Barrier is self-synchronizing; two phases per collective (publish /
         # consume) so a fast worker cannot overwrite a slot before every
         # peer has read the previous round.
@@ -125,6 +130,20 @@ class LoopbackRendezvous:
 
     def broadcast(self, rank: int, obj: Any, src: int = 0, tag: str = "bcast") -> Any:
         return self.exchange(rank, obj if rank == src else None, tag=tag)[src]
+
+    # --------------------------------------------------------------- mailbox
+    def post(self, rank: int, payload: Any, tag: str = "post") -> None:
+        """Non-collective one-way message (heartbeats, membership
+        announcements): never blocks on a barrier, so a dying peer cannot
+        wedge the sender."""
+        with self._lock:
+            self._mailbox.setdefault(tag, []).append((rank, payload))
+
+    def posts(self, tag: str = "post") -> List[tuple]:
+        """Drain (and clear) the mailbox for ``tag`` — the coordinator-side
+        read feeding :class:`~hydragnn_tpu.parallel.elastic.MembershipTracker`."""
+        with self._lock:
+            return self._mailbox.pop(tag, [])
 
 
 @dataclass
@@ -363,6 +382,14 @@ class ProxyRendezvous:
         self.world_size = int(world_size)
         self.timeout_s = float(timeout_s)
         self._server = None
+        # One-way mailbox (the TCP twin of LoopbackRendezvous.post):
+        # heartbeats and membership announcements from spawned workers,
+        # drained by the supervisor's membership loop. Written by coordinator
+        # handler threads, read by the supervisor.
+        self._mail_lock = tsan.instrument_lock(
+            threading.Lock(), "ProxyRendezvous._mail_lock"
+        )
+        self._mailbox: dict = {}  # guarded-by: self._mail_lock
 
     # ------------------------------------------------------------ coordinator
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -382,13 +409,38 @@ class ProxyRendezvous:
         # so at most the newest generation is incomplete.
         rounds: dict = {}  # guarded-by: lock
 
+        proxy = self
+
         class Handler(socketserver.StreamRequestHandler):
+            timeout = self.timeout_s  # per-connection read deadline
+
             def handle(self) -> None:
                 line = self.rfile.readline()
                 if not line:
                     return
-                msg = json.loads(line.decode())
+                if not line.endswith(b"\n"):
+                    # A torn frame (client died mid-write, or a deadline cut
+                    # the read): answer loudly instead of feeding half a JSON
+                    # document to the decoder.
+                    self.wfile.write(
+                        b'{"error": "partial frame (no trailing newline)"}\n'
+                    )
+                    return
+                try:
+                    msg = json.loads(line.decode())
+                except ValueError:
+                    self.wfile.write(b'{"error": "undecodable frame"}\n')
+                    return
                 tag, rank, payload = msg["tag"], int(msg["rank"]), msg["payload"]
+                if msg.get("mode") == "post":
+                    # One-way mailbox post: store and ACK immediately — a
+                    # heartbeat must never block on a barrier round.
+                    with proxy._mail_lock:
+                        proxy._mailbox.setdefault(tag, []).append(
+                            (rank, payload)
+                        )
+                    self.wfile.write(b'{"result": "posted"}\n')
+                    return
                 with lock:
                     gens = rounds.setdefault(tag, [])
                     if not gens or gens[-1]["done"].is_set():
@@ -451,31 +503,112 @@ class ProxyRendezvous:
             self._server.server_close()
             self._server = None
 
+    # ------------------------------------------------------- server-side drain
+    def posts(self, tag: str = "post") -> List[tuple]:
+        """Drain (and clear) the coordinator-side mailbox for ``tag`` — the
+        supervisor's membership loop feeds these into a
+        :class:`~hydragnn_tpu.parallel.elastic.MembershipTracker`."""
+        with self._mail_lock:
+            return self._mailbox.pop(tag, [])
+
     # ----------------------------------------------------------------- client
+    @staticmethod
+    def _round_trip(
+        address: str,
+        doc: dict,
+        timeout_s: float,
+        connect_retries: int = 2,
+    ) -> dict:
+        """One hardened request/reply frame: connect with capped-backoff
+        retry (the ``DeviceFeed(transfer_retries=)`` transient-failure
+        policy, applied to the wire — a coordinator still binding its socket
+        must not fail the whole world), write+read under explicit deadlines,
+        and a LOUD partial-frame error instead of a hang or a bare JSON
+        decode crash when the peer dies mid-frame."""
+        import socket
+        import time as _time
+
+        host, _, port = address.partition(":")
+        what = doc.get("tag", "?")
+        last_err: Optional[Exception] = None
+        for attempt in range(connect_retries + 1):
+            try:
+                conn = socket.create_connection(
+                    (host, int(port)), timeout=timeout_s
+                )
+                break
+            except OSError as e:
+                last_err = e
+                if attempt >= connect_retries:
+                    raise LoopbackError(
+                        f"proxy rendezvous {what!r}: connect to {address} "
+                        f"failed after {attempt + 1} attempt(s): {e}"
+                    ) from e
+                _time.sleep(min(0.05 * (2**attempt), 1.0))
+        else:  # pragma: no cover - loop always breaks or raises
+            raise LoopbackError(str(last_err))
+        with conn as s:
+            # Write AND read deadlines: a wedged coordinator surfaces as a
+            # socket.timeout here, never an unbounded hang.
+            s.settimeout(timeout_s)
+            f = s.makefile("rwb")
+            f.write((json.dumps(doc) + "\n").encode())
+            f.flush()
+            try:
+                line = f.readline()
+            except OSError as e:  # socket.timeout is an OSError subclass
+                raise LoopbackError(
+                    f"proxy rendezvous {what!r}: reply read from {address} "
+                    f"timed out/failed after {timeout_s:g}s: {e}"
+                ) from e
+        if not line or not line.endswith(b"\n"):
+            raise LoopbackError(
+                f"proxy rendezvous {what!r}: partial frame from {address} "
+                f"({len(line)} byte(s) without a trailing newline) — the "
+                "coordinator died or a deadline cut the reply mid-frame"
+            )
+        try:
+            return json.loads(line.decode())
+        except ValueError as e:
+            raise LoopbackError(
+                f"proxy rendezvous {what!r}: undecodable reply frame from "
+                f"{address}: {e}"
+            ) from e
+
     @staticmethod
     def allgather(
         address: str, tag: str, rank: int, payload: Any,
         timeout_s: float = _BARRIER_TIMEOUT_S,
+        connect_retries: int = 2,
     ) -> List[Any]:
         """Client side: post this rank's payload for ``tag``, block until all
         ranks posted, return the rank-ordered payload list."""
-        import socket
-
-        host, _, port = address.partition(":")
-        with socket.create_connection((host, int(port)), timeout=timeout_s) as s:
-            f = s.makefile("rwb")
-            f.write(
-                (
-                    json.dumps({"tag": tag, "rank": rank, "payload": payload})
-                    + "\n"
-                ).encode()
-            )
-            f.flush()
-            s.settimeout(timeout_s)
-            reply = json.loads(f.readline().decode())
+        reply = ProxyRendezvous._round_trip(
+            address,
+            {"tag": tag, "rank": rank, "payload": payload},
+            timeout_s,
+            connect_retries=connect_retries,
+        )
         if "error" in reply:
             raise LoopbackError(f"proxy rendezvous {tag!r}: {reply['error']}")
         return reply["result"]
+
+    @staticmethod
+    def post(
+        address: str, tag: str, rank: int, payload: Any,
+        timeout_s: float = 10.0,
+        connect_retries: int = 2,
+    ) -> None:
+        """One-way mailbox post (heartbeats, membership announcements):
+        ACKed by the coordinator immediately, never blocks on a barrier."""
+        reply = ProxyRendezvous._round_trip(
+            address,
+            {"tag": tag, "rank": rank, "payload": payload, "mode": "post"},
+            timeout_s,
+            connect_retries=connect_retries,
+        )
+        if "error" in reply:
+            raise LoopbackError(f"proxy rendezvous {tag!r}: {reply['error']}")
 
     @staticmethod
     def barrier(

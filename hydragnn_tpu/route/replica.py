@@ -103,6 +103,21 @@ class Replica:
         buckets, fault counters, hydration counters). Raising == down."""
         raise NotImplementedError
 
+    def swap_checkpoint(
+        self,
+        path: str,
+        version: Optional[str] = None,
+        expected_identity: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Hot-swap this replica onto the v2 checkpoint at ``path`` (a
+        shared-storage path the replica's own process can read) — the
+        fleet-orchestration surface ``LifecycleManager`` drives for replicas
+        it holds no engine object for (docs/SERVING.md "Live model
+        lifecycle"). Same refusal semantics as ``engine.swap_weights``:
+        identity/fingerprint/tolerance mismatches raise and the replica
+        keeps serving its current version."""
+        raise NotImplementedError
+
     def close(self) -> None:  # pragma: no cover - interface default
         pass
 
@@ -184,6 +199,19 @@ class InProcessReplica(Replica):
             "compiled_fresh_buckets": counters["cache_misses_total"],
             "replica": self.name,
         }
+
+    def swap_checkpoint(
+        self,
+        path: str,
+        version: Optional[str] = None,
+        expected_identity: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        from ..serve.engine import swap_from_checkpoint
+
+        return swap_from_checkpoint(
+            self.engine, path, version=version,
+            expected_identity=expected_identity,
+        )
 
     def close(self) -> None:
         self.engine.close()
@@ -300,6 +328,41 @@ class HttpReplica(Replica):
             [np.asarray(h, dtype=np.float32) for h in per_graph]
             for per_graph in doc["predictions"]
         ], version
+
+    def swap_checkpoint(
+        self,
+        path: str,
+        version: Optional[str] = None,
+        expected_identity: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """POST /swap on the replica (it must run with ``--admin``): the
+        replica loads ``path`` from ITS filesystem — a fleet shares the
+        checkpoint store the same way it shares the graftcache store."""
+        doc: Dict[str, Any] = {"checkpoint": path}
+        if version:
+            doc["version"] = version
+        if expected_identity:
+            doc["expected_identity"] = expected_identity
+        req = urllib.request.Request(
+            self.base_url + "/swap",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return self._read_json(resp)
+        except urllib.error.HTTPError as e:
+            payload = self._read_json(e)
+            err = payload.get("error", f"HTTP {e.code}")
+            if e.code in (502, 503):
+                raise ReplicaDownError(f"replica {self.name}: {err}") from e
+            # 403 (admin disabled), 409 (refused swap), 400 (bad file): the
+            # replica is healthy and KEPT its version — surface the refusal.
+            raise ReplicaError(
+                f"replica {self.name}: swap refused (HTTP {e.code}): {err}"
+            ) from e
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise ReplicaDownError(f"replica {self.name}: {e}") from e
 
     def health(self) -> Dict[str, Any]:
         try:

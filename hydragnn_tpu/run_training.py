@@ -89,6 +89,45 @@ def _(config: dict, mesh=None, supervise=False, max_restarts=3):
     from .parallel.distributed import config_graph_axis
 
     graph_axis = config_graph_axis(config)
+    # graftelastic (docs/DISTRIBUTED.md "Elastic runbook"): a RESUMING
+    # incarnation consumes the supervisor.json `mesh` block — a topology that
+    # contradicts the persisted world/axis metadata fails loudly with both
+    # topologies named, unless Training.elastic admits the new world size
+    # (then it is a logged elastic transition: the loader re-shards and the
+    # mesh rebuilds at the current world below, exactly as on a fresh start).
+    if config["NeuralNetwork"]["Training"].get("resume"):
+        from .faults.supervisor import read_supervisor_meta
+        from .parallel.elastic import ElasticConfig, check_restart_topology
+
+        sup_meta = read_supervisor_meta(get_log_name_config(config))
+        if sup_meta.get("mesh"):
+            transition = check_restart_topology(
+                sup_meta["mesh"],
+                world_size,
+                graph_axis,
+                ElasticConfig.from_training(
+                    config["NeuralNetwork"]["Training"]
+                ),
+            )
+            if transition is not None:
+                from .utils.print_utils import log as _log
+
+                _log(
+                    f"elastic restart: world_size "
+                    f"{transition['from_world']} -> {transition['to_world']} "
+                    f"({transition['kind']}) — loader re-shards and the mesh "
+                    "rebuilds at the new size"
+                )
+                if world_rank == 0:
+                    # Keep the persisted topology truthful for standalone
+                    # resumes too — the supervisor's own restart loop records
+                    # the same event when IT observes the change.
+                    from .faults.supervisor import record_elastic_transition
+
+                    record_elastic_transition(
+                        get_log_name_config(config),
+                        dict(transition, observed_by="run_training"),
+                    )
     if mesh is None and (world_size > 1 or graph_axis > 1):
         # Reference semantics: training is data-parallel whenever the process
         # group is initialized (DDP wrap, reference run_training.py:78 +

@@ -133,6 +133,14 @@ def build_parser() -> argparse.ArgumentParser:
         "so the router's hop logs and health map name it (docs/SERVING.md "
         '"Multi-replica tier")',
     )
+    ap.add_argument(
+        "--admin",
+        action="store_true",
+        help="enable the POST /swap admin endpoint so a LifecycleManager "
+        "can hot-swap this replica's weights from a (shared-storage) "
+        "checkpoint path — fleet-wide swap orchestration for spawned HTTP "
+        'replicas (docs/SERVING.md "Live model lifecycle")',
+    )
     ap.add_argument("--verbose", action="store_true")
     return ap
 
@@ -233,6 +241,7 @@ def main(argv=None) -> int:
         port=args.port,
         verbose=args.verbose,
         replica_id=args.replica_id,
+        enable_admin=args.admin,
     )
     print(
         f"hydragnn_tpu.serve listening on http://{server.host}:{server.port} "

@@ -97,6 +97,15 @@ class PrecisionToleranceError(RuntimeError):
         self.report = report
 
 
+class SwapIdentityError(RuntimeError):
+    """:func:`swap_from_checkpoint` rejected the checkpoint file: its
+    verified content identity does not match the identity the caller pinned
+    (``expected_identity``) — the file changed since it was staged. The
+    engine keeps serving its current weights. A dedicated type so the /swap
+    endpoint and orchestration callers classify the refusal structurally,
+    never by parsing the message."""
+
+
 class SwapFingerprintError(RuntimeError):
     """:meth:`InferenceEngine.swap_weights` rejected the incoming variables:
     their param-tree fingerprint (key paths/shapes/dtypes) does not match the
@@ -505,6 +514,19 @@ class InferenceEngine:
         gate, status surfaces) may observe the weights."""
         with self._lock:
             return self._weights
+
+    def variables_template(self) -> Dict[str, Any]:
+        """THE variables template verified checkpoint loads restore onto
+        (flax ``from_bytes``: structure used, values ignored). For quantized
+        arms the retained f32 reference is the honest template — the served
+        params carry the same tree either way. One definition shared by
+        ``swap_from_checkpoint`` and ``LifecycleManager._template`` so the
+        /swap path and the in-process lifecycle path can never diverge."""
+        ref = getattr(self, "_ref_variables", None)
+        if ref is not None:
+            return ref
+        params, bstats, _v = self._current_weights()
+        return {"params": params, "batch_stats": bstats}
 
     @property
     def model_version(self) -> str:
@@ -1627,3 +1649,46 @@ class InferenceEngine:
         except Exception:
             pass
         return "torch"
+
+
+# --------------------------------------------------------- checkpoint hot swap
+def swap_from_checkpoint(
+    engine: InferenceEngine,
+    path: str,
+    version: Optional[str] = None,
+    expected_identity: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Load a v2 checkpoint FILE and hot-swap it into ``engine`` — the shared
+    implementation behind the ``/swap`` admin endpoint (serve/server.py) and
+    ``Replica.swap_checkpoint`` (route/replica.py), so ``LifecycleManager``
+    can drive spawned HTTP replicas with the exact semantics of an
+    in-process ``engine.swap_weights`` (docs/SERVING.md "Live model
+    lifecycle").
+
+    ONE read: the bytes whose content identity is computed are the bytes
+    deserialized (``checkpoint.io.load_checkpoint_bytes`` — the TOCTOU-free
+    candidate-load contract from graftswap). ``expected_identity``, when
+    given, must match the file's full content identity — the caller's staged
+    version and the weights that publish provably attest the same bytes.
+    ``version`` defaults to the identity's 12-hex short form (the registry's
+    display convention). Returns the swap report plus ``identity``/``epoch``.
+    """
+    from ..checkpoint.format import content_identity
+    from ..checkpoint.io import load_checkpoint_bytes
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    identity, _details = content_identity(blob, path)
+    if expected_identity and identity != expected_identity:
+        raise SwapIdentityError(
+            f"{path}: content identity {identity[:12]} does not match the "
+            f"expected {expected_identity[:12]} — the file changed since it "
+            "was staged; the engine keeps serving its current version"
+        )
+    variables, _opt, meta = load_checkpoint_bytes(
+        engine.variables_template(), blob, path
+    )
+    report = engine.swap_weights(variables, version or identity[:12])
+    report["identity"] = identity
+    report["epoch"] = (meta or {}).get("epoch")
+    return report

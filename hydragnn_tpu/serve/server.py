@@ -242,6 +242,9 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
         # parse leftover body bytes as the NEXT request line after a 404.
         length = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(length) if length else b""
+        if self.path == "/swap":
+            self._handle_swap(body, rid)
+            return
         if self.path != "/predict":
             self._send_json(404, {"error": f"unknown path {self.path}"})
             return
@@ -285,6 +288,78 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
             self._send_json(503, {"error": str(e), "request_id": rid})
             return
 
+        self._finish_predict(rid, results, versions)
+
+    def _handle_swap(self, body: bytes, rid: str) -> None:
+        """POST /swap — the fleet-orchestration admin endpoint (ROADMAP item
+        4 remainder): ``{"checkpoint": <path>, "version"?: <str>,
+        "expected_identity"?: <hex>}`` loads the named v2 checkpoint from
+        THIS replica's filesystem (shared storage in a fleet) and hot-swaps
+        it through ``engine.swap_weights`` — zero recompiles, per-request
+        version consistency, the ``X-HydraGNN-Model-Version`` header flips
+        on the next response. Gated behind ``--admin`` (serving replicas
+        must opt in to being driven): 403 otherwise. Refusals keep serving:
+        409 on identity/fingerprint/tolerance-gate mismatches, 400 on a
+        missing/corrupt file, 503 on a dead engine."""
+        if not getattr(self.server, "allow_admin", False):  # type: ignore[attr-defined]
+            self._send_json(
+                403,
+                {
+                    "error": "/swap is disabled — start the replica with "
+                    "--admin to allow lifecycle orchestration",
+                    "request_id": rid,
+                },
+            )
+            return
+        from ..checkpoint.format import CheckpointError
+        from .engine import (
+            PrecisionToleranceError,
+            SwapFingerprintError,
+            SwapIdentityError,
+            swap_from_checkpoint,
+        )
+
+        try:
+            doc = json.loads(body or b"{}")
+            path = doc.get("checkpoint")
+            if not isinstance(path, str) or not path:
+                raise ValueError(
+                    'body must be {"checkpoint": "<path>", "version"?: ..., '
+                    '"expected_identity"?: ...}'
+                )
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": str(e), "request_id": rid})
+            return
+        try:
+            report = swap_from_checkpoint(
+                self.engine,
+                path,
+                version=doc.get("version"),
+                expected_identity=doc.get("expected_identity"),
+            )
+        except (
+            SwapIdentityError,
+            SwapFingerprintError,
+            PrecisionToleranceError,
+        ) as e:
+            self._send_json(409, {"error": str(e), "request_id": rid})
+            return
+        except CheckpointError as e:
+            # Corrupt/unreadable/wrong-format file: the candidate is bad, the
+            # replica keeps serving.
+            self._send_json(400, {"error": str(e), "request_id": rid})
+            return
+        except OSError as e:
+            self._send_json(400, {"error": str(e), "request_id": rid})
+            return
+        except (EngineFailedError, RuntimeError) as e:
+            self._send_json(503, {"error": str(e), "request_id": rid})
+            return
+        self._mv_override = report["version"]
+        self._send_json(200, {"request_id": rid, "swapped": True, **report})
+
+    def _finish_predict(self, rid: str, results, versions) -> None:
+        engine = self.engine
         # The header (and body field) report the version that actually
         # answered: the newest version any of the call's graphs executed
         # against — for single-graph requests (the swap drill's shape) this
@@ -331,10 +406,15 @@ class InferenceServer:
         request_timeout_s: float = 60.0,
         verbose: bool = False,
         replica_id: Optional[str] = None,
+        enable_admin: bool = False,
     ):
         self.engine = engine
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.engine = engine  # type: ignore[attr-defined]
+        # /swap fleet orchestration (docs/SERVING.md "Live model
+        # lifecycle"): replicas must OPT IN to being driven — the endpoint
+        # loads checkpoints from this process's filesystem.
+        self._httpd.allow_admin = bool(enable_admin)  # type: ignore[attr-defined]
         # Every response path names the serving model version (the
         # lifecycle echo contract — see RequestPlumbing._model_version).
         self._httpd.model_version_fn = lambda: engine.model_version  # type: ignore[attr-defined]

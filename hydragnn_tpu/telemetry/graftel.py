@@ -21,8 +21,10 @@ is the hub they all emit into:
   the ring + counter/gauge snapshot to
   ``<run_dir>/flightrec_<pid>_<seq>_<trigger>.json``. Wired triggers:
   non-finite step-guard trips (faults/guard.py), engine poisoning
-  (serve/engine.py), checkpoint-fallback loads (checkpoint/io.py), and
-  supervisor restarts (faults/supervisor.py).
+  (serve/engine.py), checkpoint-fallback loads (checkpoint/io.py),
+  supervisor restarts (faults/supervisor.py), and elastic dirty-shrink
+  transitions (parallel/elastic.py — the timeline that led into a worker
+  death, next to the checkpoint the shrunk world resumed from).
 
 * **Metric registry.** ``counter``/``gauge``/``timer_credit`` feed one locked
   registry; ``Timer`` and ``FaultCounters`` delegate their storage here, so

@@ -45,6 +45,65 @@ from .trainer import (
 )
 
 
+# One pump per process: started lazily by the first supervised epoch loop.
+_heartbeat_pump_started = False
+
+
+def _start_supervisor_heartbeat_pump() -> None:
+    """graftelastic child-side liveness (docs/DISTRIBUTED.md "Elastic
+    runbook"): under an elastic supervisor (``HYDRAGNN_ELASTIC_COORD``), a
+    daemon timer thread beats every ``heartbeat_s / 4`` for the PROCESS
+    lifetime — liveness must not depend on epoch cadence, or a
+    compile-inflated first epoch (XLA compiles dwarf the steady wall) and
+    the beat-less post-loop finalization would read as hangs. The per-epoch
+    beat below still runs for epoch attribution in the coordinator log."""
+    global _heartbeat_pump_started
+    if _heartbeat_pump_started or not os.environ.get("HYDRAGNN_ELASTIC_COORD"):
+        return
+    _heartbeat_pump_started = True
+    import threading
+
+    try:
+        hb = float(os.environ.get("HYDRAGNN_ELASTIC_HEARTBEAT_S") or 5.0)
+    except ValueError:
+        hb = 5.0
+    interval = max(0.2, hb / 4.0)
+
+    def pump() -> None:
+        while True:
+            _post_supervisor_heartbeat(None)
+            time.sleep(interval)
+
+    threading.Thread(
+        target=pump, name="elastic-heartbeat-pump", daemon=True
+    ).start()
+
+
+def _post_supervisor_heartbeat(epoch: Optional[int] = None) -> None:
+    """One best-effort beat into the elastic supervisor's coordinator
+    mailbox (no-op without ``HYDRAGNN_ELASTIC_COORD``). Best-effort by
+    design — a beat that cannot land is exactly the signal the supervisor's
+    heartbeat deadline exists to catch, and a failed post must never take
+    down the training it reports on."""
+    addr = os.environ.get("HYDRAGNN_ELASTIC_COORD")
+    if not addr:
+        return
+    from ..parallel.loopback import LoopbackError, ProxyRendezvous
+
+    rank = jax.process_index()
+    try:
+        ProxyRendezvous.post(
+            addr,
+            "heartbeat",
+            rank=rank,
+            payload={"wid": f"proc{rank}", "epoch": epoch, "pid": os.getpid()},
+            timeout_s=5.0,
+            connect_retries=1,
+        )
+    except (LoopbackError, OSError):
+        pass  # missed beat == the supervisor's deadline does its job
+
+
 class EpochMetrics:
     """Graph-count-weighted averages accumulated over an epoch. The guarded
     step's extra ``bad`` metric is consumed by StepGuard (per step/chunk) and
@@ -939,6 +998,8 @@ def train_validate_test(
         checkpointer = AsyncCheckpointer()
     try:
         for epoch in range(start_epoch, num_epoch):
+            _start_supervisor_heartbeat_pump()
+            _post_supervisor_heartbeat(epoch)
             for loader in (train_loader, val_loader, test_loader):
                 if hasattr(loader, "set_epoch"):
                     loader.set_epoch(epoch)

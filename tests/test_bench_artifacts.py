@@ -397,3 +397,57 @@ def pytest_committed_multichip_artifact_readable():
     assert blk["overlap_fraction"]["bucketed"] is not None
     if blk["backend"] == "cpu":
         assert blk["timings_meaningful"] is False
+
+
+def pytest_last_known_elastic_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_elastic
+
+    real = {
+        "metric": "elastic_drills",
+        "value": 4.0,
+        "unit": "drills_passed",
+        "drills_passed": 4,
+        "drills_total": 4,
+        "convergence_parity": {"ok": True},
+        "warm_restart": {"ok": True},
+        "backend": "cpu",
+    }
+    (tmp_path / "ELASTIC_r15.json").write_text(json.dumps(real))
+    # A failed round carries drills_passed 0 — never "last known".
+    (tmp_path / "ELASTIC_r16.json").write_text(
+        json.dumps({"metric": "elastic_drills", "value": 0.0, "drills_passed": 0})
+    )
+    now = time.time()
+    os.utime(tmp_path / "ELASTIC_r15.json", (now - 50, now - 50))
+    os.utime(tmp_path / "ELASTIC_r16.json", (now - 5, now - 5))
+
+    blk = _last_known_elastic(str(tmp_path))
+    assert blk is not None
+    assert blk["drills_passed"] == 4
+    assert blk["convergence_parity_ok"] is True
+    assert blk["warm_restart_ok"] is True
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "ELASTIC_r15.json"
+
+
+def pytest_last_known_elastic_none_when_no_measurements(tmp_path):
+    from bench import _last_known_elastic
+
+    (tmp_path / "ELASTIC_bad.json").write_text("{not json")
+    (tmp_path / "ELASTIC_r09.json").write_text(
+        json.dumps({"ok": True, "value": 1.0})  # no metric field
+    )
+    assert _last_known_elastic(str(tmp_path)) is None
+
+
+def pytest_committed_elastic_artifact_readable():
+    """The committed ELASTIC_r* round is a valid last-known block with all
+    four drills green plus the parity and warm-restart gates."""
+    from bench import _last_known_elastic
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_elastic(repo)
+    assert blk is not None
+    assert blk["drills_passed"] == blk["drills_total"] == 4
+    assert blk["convergence_parity_ok"] is True
+    assert blk["warm_restart_ok"] is True

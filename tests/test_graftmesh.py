@@ -498,6 +498,22 @@ def pytest_supervisor_meta_records_mesh_topology(tmp_path, monkeypatch):
     monkeypatch.setattr(
         subprocess, "run", lambda *a, **k: SimpleNamespace(returncode=0)
     )
+
+    # Elastic configs take the MONITORED child path (Popen + heartbeat
+    # drain, graftelastic) instead of subprocess.run — fake that too.
+    class _FakeProc:
+        pid = 12345
+
+        def poll(self):
+            return 0
+
+        def kill(self):
+            pass
+
+        def wait(self, timeout=None):
+            return 0
+
+    monkeypatch.setattr(subprocess, "Popen", lambda *a, **k: _FakeProc())
     REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(REPO, "tests/inputs/ci.json")) as f:
         config = json.load(f)
@@ -516,6 +532,11 @@ def pytest_supervisor_meta_records_mesh_topology(tmp_path, monkeypatch):
     assert meta["mesh"]["grad_sync"] == "bucketed"
     assert meta["mesh"]["elastic"] == {"min_workers": 1, "max_workers": 2}
     assert meta["mesh"]["world_size"] == 1
+    # The elastic membership loop annotates each attempt (graftelastic).
+    assert meta["attempts"][0]["world_size"] == 1
+    assert meta["attempts"][0]["heartbeats"] == 0
+    assert meta["attempts"][0]["stalled"] is False
+    assert meta["elastic_transitions"] == []
     run_dir = next((tmp_path / "logs").iterdir())
     with open(run_dir / "supervisor.json") as f:
         assert json.load(f)["mesh"]["grad_sync"] == "bucketed"

@@ -465,7 +465,137 @@ def pytest_swap_http_e2e_version_headers():
         server.shutdown()
 
 
-# -------------------------------------- 8. kill-during-swap resume (slow)
+# ----------------------------- 8. /swap admin endpoint + HTTP-fleet driving
+def pytest_swap_admin_endpoint_http_e2e(tmp_path):
+    """The ROADMAP item-4 remainder: POST /swap on the engine HTTP server —
+    admin-gated (403 without --admin), verified checkpoint load with
+    optional identity pinning (409 on mismatch), zero recompiles, version
+    header flips, and ``HttpReplica.swap_checkpoint`` drives it."""
+    from hydragnn_tpu.checkpoint.format import file_content_identity
+    from hydragnn_tpu.route import HttpReplica, ReplicaError
+
+    engine, graphs = build_serving_engine(model_version="live0", **SMALL)
+    vars0 = _host_vars(engine)
+    name = "swapadmin"
+    save_model(vars0, None, name, path=str(tmp_path), meta={"epoch": 1})
+    ckpt = os.path.join(str(tmp_path), name, name + ".pk")
+    identity, _ = file_content_identity(ckpt)
+
+    def post_swap(base, doc):
+        req = urllib.request.Request(
+            base + "/swap",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+    # Admin OFF (the default): 403, nothing swaps.
+    server = InferenceServer(engine, port=0).start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post_swap(base, {"checkpoint": ckpt})
+        assert exc.value.code == 403
+        assert engine.model_version == "live0"
+    finally:
+        server.shutdown(close_engine=False)
+
+    server = InferenceServer(engine, port=0, enable_admin=True)
+    server.start_background()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        baseline = engine.predict([graphs[0]])[0]
+        c0 = compile_count()
+        status, body, headers = post_swap(
+            base,
+            {
+                "checkpoint": ckpt,
+                "version": "swapped1",
+                "expected_identity": identity,
+            },
+        )
+        assert status == 200 and body["swapped"] is True
+        assert body["version"] == "swapped1"
+        assert body["identity"] == identity
+        assert body["epoch"] == 1
+        assert headers["X-HydraGNN-Model-Version"] == "swapped1"
+        assert engine.model_version == "swapped1"
+        assert compile_count() - c0 == 0, "/swap must not recompile"
+        after = engine.predict([graphs[0]])[0]  # same weights: bit-exact
+        assert all(np.array_equal(a, b) for a, b in zip(baseline, after))
+
+        # Identity pinning: a wrong expected identity is a 409 refusal and
+        # the engine keeps its version.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post_swap(
+                base,
+                {"checkpoint": ckpt, "expected_identity": "0" * 64},
+            )
+        assert exc.value.code == 409
+        assert engine.model_version == "swapped1"
+        # Missing file: 400.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post_swap(base, {"checkpoint": ckpt + ".nope"})
+        assert exc.value.code == 400
+        # Malformed body: 400.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post_swap(base, {"not-checkpoint": 1})
+        assert exc.value.code == 400
+
+        # The Replica surface LifecycleManager drives: swap via path,
+        # refusals surface as ReplicaError (replica healthy, version kept).
+        replica = HttpReplica("r0", base)
+        report = replica.swap_checkpoint(ckpt, version="swapped2")
+        assert report["version"] == "swapped2"
+        assert replica.health()["model_version"] == "swapped2"
+        with pytest.raises(ReplicaError, match="swap refused"):
+            replica.swap_checkpoint(ckpt, expected_identity="1" * 64)
+        assert replica.health()["model_version"] == "swapped2"
+    finally:
+        server.shutdown()
+
+
+def pytest_lifecycle_manager_drives_http_replicas(tmp_path):
+    """A pure path-driven fleet (HttpReplica only — the spawned-replica
+    shape): promote() re-verifies the candidate's content identity, swaps
+    every replica through /swap with the identity pinned, and rollback
+    restores the previous version — no in-process engine object anywhere."""
+    from hydragnn_tpu.route import HttpReplica
+
+    registry, engines, graphs, _run_dir, vars0 = _swap_fixture(
+        str(tmp_path), n_replicas=1, **SMALL
+    )
+    engine = engines[0]
+    server = InferenceServer(engine, port=0, enable_admin=True)
+    server.start_background()
+    try:
+        replica = HttpReplica("http-0", f"http://127.0.0.1:{server.port}")
+        manager = LifecycleManager(registry, [replica])
+        live = registry.live
+        save_model(
+            _perturb(vars0, 1e-2, seed=2),
+            None,
+            registry.name,
+            path=str(tmp_path),
+            meta={"epoch": 2},
+            keep_last_k=3,
+        )
+        cand = manager.stage_candidate()
+        report = manager.promote()
+        assert report["version"] == cand.short
+        assert report["epoch"] == 2
+        assert replica.health()["model_version"] == cand.short
+        assert registry.live.version == cand.version
+
+        rollback = manager.rollback()
+        assert rollback["version"] == live.short
+        assert replica.health()["model_version"] == live.short
+    finally:
+        server.shutdown()
+
+
+# -------------------------------------- 9. kill-during-swap resume (slow)
 @pytest.mark.slow
 def pytest_supervisor_kill_during_swap_resume():
     from benchmarks.serve_load import kill_during_swap_drill
