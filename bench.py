@@ -1223,6 +1223,82 @@ def elastic_main() -> int:
     return 0 if result.get("ok") else 1
 
 
+def _last_known_stream(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent real streaming data-plane A/B from any committed STREAM_*
+    artifact — the graftstream analog of ``_last_known_hardware``. A failed
+    ``--stream`` round embeds this block with ``provenance: "stale"`` so an
+    rc=1 round still carries the last known A/B verdicts."""
+
+    def extract(doc):
+        if not doc.get("ok") or doc.get("metric") != "stream_ab":
+            return None
+        ab = doc.get("train_ab") or {}
+        return {
+            "value": doc.get("value"),
+            "unit": doc.get("unit"),
+            "params_bit_exact": ab.get("params_bit_exact"),
+            "streamed_over_inmemory_wall": ab.get("streamed_over_inmemory_wall"),
+            "drills_passed": doc.get("drills_passed"),
+            "drills_total": doc.get("drills_total"),
+            "backend": doc.get("backend"),
+        }
+
+    return _latest_artifact_block("STREAM_*.json", extract, search_dir)
+
+
+def stream_main() -> int:
+    """``python bench.py --stream``: the graftstream out-of-core data-plane
+    A/B + drill matrix (benchmarks/stream_bench.py) — in-memory vs streamed
+    steady-epoch wall with the FeedStats split, batch-inference graphs/s over
+    prediction shards, corrupt-shard quarantine drill, and the elastic N→M
+    transition over a streamed corpus. Writes STREAM_rNN.json; failure embeds
+    the last known round, stale-labeled, per the established convention."""
+    result = {
+        "metric": "stream_ab",
+        "value": 0.0,
+        "unit": "batch_infer_graphs_per_sec",
+    }
+    from hydragnn_tpu.utils.artifacts import round_tag
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"STREAM_r{round_tag()}.json",
+    )
+    try:
+        import jax
+
+        if os.environ.get("HYDRAGNN_TPU_TESTS") != "1":
+            jax.config.update("jax_platforms", "cpu")
+
+        result["backend"] = jax.default_backend()
+        result["device_kind"] = jax.devices()[0].device_kind
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.stream_bench import run_stream_bench
+
+        result.update(run_stream_bench())
+        result["value"] = float(
+            (result.get("batch_inference") or {}).get("graphs_per_sec") or 0.0
+        )
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        result["artifact"] = os.path.basename(out_path)
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        try:
+            stale = _last_known_stream()
+            if stale is not None:
+                result["last_known_stream"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+    print(json.dumps(result))
+    return 0 if result.get("ok") else 1
+
+
 def _last_known_precision(search_dir: "str | None" = None) -> "dict | None":
     """Most recent real mixed-precision A/B from any committed PRECISION_*
     artifact — the graftprec analog of ``_last_known_hardware``. A failed
@@ -2105,6 +2181,8 @@ if __name__ == "__main__":
         sys.exit(multichip_main())
     if "--elastic" in sys.argv:
         sys.exit(elastic_main())
+    if "--stream" in sys.argv:
+        sys.exit(stream_main())
     if "--precision" in sys.argv:
         sys.exit(precision_main())
     if "--analyze" in sys.argv:

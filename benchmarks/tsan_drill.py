@@ -65,7 +65,11 @@ from hydragnn_tpu.analysis import tsan, trace_paths  # noqa: E402
 # Yield sites whose visit counts are workload-determined (not race-
 # determined), so their recorded decision streams must be bit-identical
 # across same-seed runs — the determinism witness the tests compare.
-_DETERMINISTIC_SITES = ("ckpt.save.pre_enqueue", "serve.submit.pre_enqueue")
+_DETERMINISTIC_SITES = (
+    "ckpt.save.pre_enqueue",
+    "serve.submit.pre_enqueue",
+    "stream.ring.pre_put",
+)
 
 _CKPT_SAVES = 3
 _SERVE_REQUESTS = 8
@@ -373,6 +377,50 @@ def _elastic_drill() -> None:
     assert "hb3" in change.dead, change
 
 
+def _stream_drill(tmpdir: str) -> None:
+    """graftstream path (ISSUE 16): the shard-prefetch ring's bounded queue
+    under schedule perturbation — ShardRing._lock stats updates on the
+    "hydragnn-shard-prefetch" thread racing consumer ``stats()`` reads
+    (yield site ``stream.ring.pre_put`` widens the decode-to-publish
+    window), the Belady replay path (capacity below the epoch's shard set
+    keeps the ring live the whole epoch, racing consumer-side eviction),
+    and an abandoned-consumer ``close()`` (cancel must wake a producer
+    blocked on the full depth-1 queue — never a leaked thread)."""
+    from hydragnn_tpu.datasets import shards
+    from hydragnn_tpu.datasets.stream import ShardRing, StreamingGraphLoader
+    from hydragnn_tpu.graphs.sample import GraphSample
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(24):
+        n = int(rng.integers(3, 7))
+        e = int(rng.integers(2, 5))
+        samples.append(
+            GraphSample(
+                x=rng.standard_normal((n, 4)).astype(np.float32),
+                pos=rng.standard_normal((n, 3)).astype(np.float32),
+                edge_index=rng.integers(0, n, size=(2, e)).astype(np.int64),
+            )
+        )
+    corpus = os.path.join(tmpdir, "stream_corpus")
+    shards.write_gshd(corpus, samples, shard_size=4, name="tsan_stream")
+
+    loader = StreamingGraphLoader(
+        corpus, batch_size=5, shuffle=True, seed=_SEED,
+        resident_shards=2, ring_depth=1,
+    )
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for _ in loader:
+            loader.ring_stats()
+
+    ring = ShardRing(list(range(6)), loader._decode_shard, depth=1)
+    ring.get()
+    ring.stats()
+    ring.close()
+    assert ring.join(30), "shard-prefetch thread leaked past close()"
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -385,6 +433,7 @@ def run_drill(seed: int) -> dict:
         _swap_drill(tmpdir)
         _mesh_drill()
         _elastic_drill()
+        _stream_drill(tmpdir)
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

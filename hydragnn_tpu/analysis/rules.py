@@ -220,6 +220,11 @@ COLLATION_DETERMINISTIC_MODULES = (
     "graphs/packing.py",
     "preprocess/dataloader.py",
     "preprocess/splitting.py",
+    # The streaming data plane: shard encoding and the epoch plan must be
+    # wall-clock-free (byte-identical conversion, bit-exact streamed epochs
+    # — docs/DATA_PLANE.md).
+    "datasets/shards.py",
+    "datasets/stream.py",
 )
 
 # Host-sync call patterns (attribute tails / dotted names / builtins).
@@ -255,6 +260,11 @@ THREAD_CALLABLE_BINDINGS = {
     "DeviceFeed": {0: "feed-host", "iterable": "feed-host",
                    1: "feed-transfer", "transfer": "feed-transfer"},
     "_Prefetcher": {0: "feed-host", "iterable": "feed-host"},
+    # The streaming loader's decode-ahead ring (datasets/stream.py): the
+    # decode callable runs on the "hydragnn-shard-prefetch" daemon thread.
+    # It must stay jax-free — decoded shards are host numpy; device work
+    # happens downstream on the sanctioned transfer stage.
+    "ShardRing": {1: "shard-prefetch", "decode": "shard-prefetch"},
 }
 
 # Factories whose NESTED function definitions run on a pipeline thread (the

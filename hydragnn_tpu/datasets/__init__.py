@@ -9,5 +9,28 @@ synthetic stand-in so every example stays runnable offline.
 
 from .md17 import load_md17
 from .qm9 import load_qm9
+from .shards import (
+    CONVERT_CMD,
+    convert_pickle_corpus,
+    is_gshd_path,
+    iter_samples,
+    read_manifest,
+    verify_gshd,
+    write_gshd,
+)
+from .stream import ShardRing, StreamingGraphLoader, plan_shard_ring
 
-__all__ = ["load_qm9", "load_md17"]
+__all__ = [
+    "load_qm9",
+    "load_md17",
+    "CONVERT_CMD",
+    "convert_pickle_corpus",
+    "is_gshd_path",
+    "iter_samples",
+    "read_manifest",
+    "verify_gshd",
+    "write_gshd",
+    "ShardRing",
+    "StreamingGraphLoader",
+    "plan_shard_ring",
+]

@@ -1,0 +1,135 @@
+"""GSHD dataset operations CLI (docs/DATA_PLANE.md "Conversion runbook")::
+
+    python -m hydragnn_tpu.datasets convert --config <config.json> <out_dir>
+    python -m hydragnn_tpu.datasets convert <corpus.pkl> <out_dir> [--config c]
+    python -m hydragnn_tpu.datasets verify  <dataset_dir | manifest.json> [--json]
+    python -m hydragnn_tpu.datasets ls      <dataset_dir | manifest.json> [--json]
+
+``convert`` migrates pickle-era corpora to GSHD. The ``--config``-only form
+reads ``Dataset.path`` from the run config (handling the ``total`` layout by
+splitting it first, exactly as training would), runs each split through
+``SerializedDataLoader`` so shards hold training-ready samples, and prints
+the ``Dataset.path`` block to paste back into the config. The two-path form
+converts a single pickle corpus (training-ready only when ``--config`` is
+given; raw samples otherwise).
+
+``verify`` is the operator preflight for a copied-around dataset directory:
+whole-file sha256 vs the manifest, v2 container digests, sample counts, and
+the index — nonzero exit on any failure. ``ls`` summarizes the manifest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import shards
+
+
+def _convert(args, ap) -> int:
+    config = None
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    if len(args.paths) == 1:
+        if config is None:
+            ap.error("convert <out_dir> requires --config (or pass "
+                     "convert <corpus.pkl> <out_dir>)")
+        out_dir = args.paths[0]
+        path_map = dict(config["Dataset"]["path"])
+        if "total" in path_map:
+            from ..preprocess.load_data import total_to_train_val_test_pkls
+
+            total_to_train_val_test_pkls(config)
+            path_map = dict(config["Dataset"]["path"])
+        new_paths = {}
+        for split, pkl in path_map.items():
+            split_dir = os.path.join(out_dir, split)
+            name = f"{config['Dataset'].get('name', 'dataset')}_{split}"
+            manifest = shards.convert_pickle_corpus(
+                pkl,
+                split_dir,
+                config=config,
+                shard_size=args.shard_size,
+                name=name,
+            )
+            new_paths[split] = split_dir
+            print(f"{split}: {pkl} -> {manifest}")
+        print('Update the config\'s "Dataset" -> "path" to:')
+        print(json.dumps(new_paths, indent=2))
+        return 0
+    pkl, out_dir = args.paths
+    manifest = shards.convert_pickle_corpus(
+        pkl, out_dir, config=config, shard_size=args.shard_size
+    )
+    print(f"wrote {manifest}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.datasets",
+        description="Convert, verify, or list GSHD streaming datasets.",
+    )
+    ap.add_argument("command", choices=("convert", "verify", "ls"))
+    ap.add_argument(
+        "paths",
+        nargs="+",
+        help="convert: [corpus.pkl] out_dir; verify/ls: dataset dir or manifest",
+    )
+    ap.add_argument("--config", help="run config JSON (training-ready shards)")
+    ap.add_argument("--shard-size", type=int, default=256,
+                    help="samples per shard (default 256)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.command == "convert":
+        if len(args.paths) > 2:
+            ap.error("convert takes at most [corpus.pkl] out_dir")
+        return _convert(args, ap)
+
+    if len(args.paths) != 1:
+        ap.error(f"{args.command} takes exactly one dataset path")
+    path = args.paths[0]
+
+    if args.command == "verify":
+        report = shards.verify_gshd(path)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            for sh in report["shards"]:
+                status = "ok" if sh["ok"] else f"CORRUPT: {sh['error']}"
+                print(f"{sh['file']}: {status}")
+            for err in report["errors"]:
+                if not any(err.startswith(s["file"]) for s in report["shards"]):
+                    print(f"ERROR: {err}")
+            verdict = "ok" if report["ok"] else "FAILED"
+            print(
+                f"{verdict}: {report['num_samples']} samples in "
+                f"{report['num_shards']} shard(s)"
+            )
+        return 0 if report["ok"] else 1
+
+    manifest = shards.read_manifest(path)
+    if args.json:
+        doc = {k: v for k, v in manifest.items() if k != "_dir"}
+        print(json.dumps(doc))
+    else:
+        print(
+            f"{manifest['name']}: {manifest['num_samples']} samples, "
+            f"{len(manifest['shards'])} shard(s), schema "
+            f"{manifest['schema']} (fields: {manifest['fields']})"
+        )
+        for sh in manifest["shards"]:
+            print(
+                f"  {sh['file']}: {sh['num_samples']} samples, "
+                f"{sh['bytes']} bytes"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,419 @@
+"""GSHD — the sharded on-disk dataset format of the streaming data plane
+(docs/DATA_PLANE.md).
+
+A GSHD dataset is a directory::
+
+    <dataset>/
+      gshd_manifest.json      # schema, shard list, per-shard size histograms
+      gshd_index.gshd         # per-sample (num_nodes, num_edges) arrays
+      shard-00000.gshd        # N samples, v2 digest-verified container
+      shard-00001.gshd
+      ...
+
+Every ``.gshd`` file is a checkpoint-layer v2 container
+(:mod:`..checkpoint.format`): msgpack framing, one sha256 digest per section,
+verified BEFORE any deserializer touches the bytes — a flipped byte in a
+shard surfaces as :class:`..checkpoint.format.CheckpointCorruptError`, which
+the streaming loader routes through its shard quarantine (one shard lost,
+loudly, never the run). The manifest is plain JSON written through the same
+``atomic_write_json`` the checkpoint sidecars use, and additionally records
+each shard file's whole-file sha256 so ``verify`` catches swapped files, not
+just flipped bytes.
+
+Sample encoding is exact: each :class:`..graphs.sample.GraphSample` field is
+stored with its original dtype and shape (per-sample shape list in the meta
+section, concatenated raveled bytes in the field's section), so a decoded
+sample is bit-identical to the sample that was written — the foundation of
+the streamed-vs-in-memory collation bit-exactness contract
+(tests/test_stream.py). Like the checkpoint container, the encoding is
+deliberately wall-clock-free: converting the same corpus twice produces
+byte-identical shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import msgpack
+import numpy as np
+
+from ..checkpoint import format as ckpt_format
+from ..checkpoint.io import atomic_write_json, write_checkpoint_blob
+from ..graphs.packing import SizeHistogram
+from ..graphs.sample import GraphSample
+
+GSHD_MANIFEST_SCHEMA = "hydragnn-gshd-manifest/v1"
+GSHD_PRED_SCHEMA = "hydragnn-gshd-predictions/v1"
+GSHD_SCHEMA_VERSION = 1
+MANIFEST_NAME = "gshd_manifest.json"
+INDEX_NAME = "gshd_index.gshd"
+
+#: The one-line migration command named by the pickle-path deprecation
+#: warning (preprocess/serialized_loader.py) and the conversion runbook.
+CONVERT_CMD = (
+    "python -m hydragnn_tpu.datasets convert --config <config.json> <out_dir>"
+)
+
+#: GraphSample fields, in a fixed serialization order.
+_FIELDS = tuple(f.name for f in dataclasses.fields(GraphSample))
+
+
+# ------------------------------------------------------------- shard encoding
+def encode_shard(samples: List[GraphSample]) -> bytes:
+    """Encode one group of samples into a v2 container blob. Each field
+    section holds the concatenation of every present sample's raveled
+    (C-order) bytes; the meta section records per-sample shapes (``None`` =
+    field absent on that sample) and the dtype, so decode reconstructs every
+    array exactly."""
+    fields_meta: Dict[str, Any] = {}
+    sections: Dict[str, Optional[bytes]] = {}
+    for name in _FIELDS:
+        arrays = [getattr(s, name) for s in samples]
+        present = [a for a in arrays if a is not None]
+        if not present:
+            continue
+        dtype = np.asarray(present[0]).dtype
+        shapes = []
+        chunks = []
+        for a in arrays:
+            if a is None:
+                shapes.append(None)
+                continue
+            arr = np.asarray(a)
+            if arr.dtype != dtype:
+                arr = arr.astype(dtype)
+            shapes.append(list(arr.shape))
+            chunks.append(np.ascontiguousarray(arr).tobytes())
+        fields_meta[name] = {"dtype": dtype.str, "shapes": shapes}
+        sections[name] = b"".join(chunks)
+    meta = {
+        "schema_version": GSHD_SCHEMA_VERSION,
+        "num_samples": len(samples),
+        "fields": fields_meta,
+        "ns": [int(s.num_nodes) for s in samples],
+        "es": [int(s.num_edges) for s in samples],
+    }
+    sections["meta"] = msgpack.packb(meta, use_bin_type=True)
+    header = {
+        "kind": "gshd-shard",
+        "schema_version": GSHD_SCHEMA_VERSION,
+        "num_samples": len(samples),
+    }
+    return ckpt_format.encode(sections, header=header)
+
+
+def decode_shard(blob: bytes, path: str = "<bytes>") -> List[GraphSample]:
+    """Digest-verify + decode one shard blob back into GraphSamples. The
+    reconstructed arrays are read-only views over the verified buffer (the
+    loader's collator copies on gather); corruption raises
+    :class:`..checkpoint.format.CheckpointCorruptError` before any field is
+    deserialized."""
+    header, sections = ckpt_format.decode(blob, path)
+    if header.get("kind") != "gshd-shard":
+        raise ckpt_format.CheckpointCorruptError(
+            path, f"not a gshd shard (kind={header.get('kind')!r})"
+        )
+    meta = msgpack.unpackb(sections["meta"], raw=False, strict_map_key=False)
+    g = int(meta["num_samples"])
+    per_sample: List[Dict[str, Optional[np.ndarray]]] = [
+        {} for _ in range(g)
+    ]
+    for name, fmeta in meta["fields"].items():
+        dtype = np.dtype(fmeta["dtype"])
+        flat = np.frombuffer(sections[name], dtype=dtype)
+        off = 0
+        for i, shape in enumerate(fmeta["shapes"]):
+            if shape is None:
+                per_sample[i][name] = None
+                continue
+            count = int(np.prod(shape)) if shape else 1
+            per_sample[i][name] = flat[off : off + count].reshape(shape)
+            off += count
+        if off != flat.size:
+            raise ckpt_format.CheckpointCorruptError(
+                path, f"field {name!r}: shape list does not cover the section"
+            )
+    return [GraphSample(**fields) for fields in per_sample]
+
+
+def load_shard(path: str) -> List[GraphSample]:
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise ckpt_format.CheckpointCorruptError(
+            path, f"unreadable ({e})"
+        ) from e
+    return decode_shard(blob, path)
+
+
+def _sha256(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ----------------------------------------------------------------- manifests
+def write_gshd(
+    out_dir: str,
+    samples: Iterable[GraphSample],
+    shard_size: int = 256,
+    name: str = "dataset",
+    minmax_node_feature=None,
+    minmax_graph_feature=None,
+) -> str:
+    """Write a GSHD dataset directory from an iterable of samples (streaming:
+    at most ``shard_size`` samples are held in memory). Returns the manifest
+    path. Shard installs go through ``write_checkpoint_blob`` (unique tmp +
+    fsync + rename) and the manifest through ``atomic_write_json`` — the same
+    durability contract as checkpoints."""
+    if shard_size < 1:
+        raise ValueError(f"shard_size must be >= 1, got {shard_size}")
+    os.makedirs(out_dir, exist_ok=True)
+    shards = []
+    all_ns: List[int] = []
+    all_es: List[int] = []
+    global_hist = SizeHistogram()
+    buf: List[GraphSample] = []
+
+    def flush():
+        sid = len(shards)
+        fname = f"shard-{sid:05d}.gshd"
+        blob = encode_shard(buf)
+        write_checkpoint_blob(os.path.join(out_dir, fname), blob)
+        hist = SizeHistogram()
+        for s in buf:
+            n, e = int(s.num_nodes), int(s.num_edges)
+            hist.record_graph(n, e)
+            global_hist.record_graph(n, e)
+            all_ns.append(n)
+            all_es.append(e)
+        shards.append(
+            {
+                "file": fname,
+                "num_samples": len(buf),
+                "bytes": len(blob),
+                "sha256": _sha256(blob),
+                "size_histogram": hist.to_json(),
+            }
+        )
+        buf.clear()
+
+    first: Optional[GraphSample] = None
+    for s in samples:
+        if first is None:
+            first = s
+        buf.append(s)
+        if len(buf) >= shard_size:
+            flush()
+    if buf:
+        flush()
+    if not shards:
+        raise ValueError("cannot write an empty GSHD dataset")
+
+    index_blob = ckpt_format.encode(
+        {
+            "ns": np.asarray(all_ns, np.int64).tobytes(),
+            "es": np.asarray(all_es, np.int64).tobytes(),
+        },
+        header={"kind": "gshd-index", "num_samples": len(all_ns)},
+    )
+    write_checkpoint_blob(os.path.join(out_dir, INDEX_NAME), index_blob)
+
+    assert first is not None
+    edge_attr_width = 0
+    if first.edge_attr is not None and np.ndim(first.edge_attr) == 2:
+        edge_attr_width = int(np.shape(first.edge_attr)[1])
+    manifest = {
+        "schema": GSHD_MANIFEST_SCHEMA,
+        "schema_version": GSHD_SCHEMA_VERSION,
+        "name": name,
+        "num_samples": len(all_ns),
+        "shards": shards,
+        "index": {
+            "file": INDEX_NAME,
+            "bytes": len(index_blob),
+            "sha256": _sha256(index_blob),
+        },
+        "fields": {
+            "x_width": int(np.shape(first.x)[1]) if first.x is not None else 0,
+            "edge_attr_width": edge_attr_width,
+            "has_y": bool(first.y is not None),
+        },
+        "minmax_node_feature": _tolist(minmax_node_feature),
+        "minmax_graph_feature": _tolist(minmax_graph_feature),
+        "size_histogram": global_hist.to_json(),
+    }
+    manifest_path = os.path.join(out_dir, MANIFEST_NAME)
+    atomic_write_json(manifest_path, manifest)
+    return manifest_path
+
+
+def _tolist(arr):
+    if arr is None:
+        return None
+    return np.asarray(arr).tolist()
+
+
+def manifest_path_of(path: str) -> str:
+    """Resolve a dataset directory OR a manifest file to the manifest path."""
+    if os.path.isdir(path):
+        return os.path.join(path, MANIFEST_NAME)
+    return path
+
+
+def is_gshd_path(path: str) -> bool:
+    """True when ``path`` names a GSHD dataset (its directory, or the
+    manifest JSON itself). Cheap: one small-JSON read, no shard access."""
+    p = manifest_path_of(path)
+    if not (p.endswith(".json") and os.path.isfile(p)):
+        return False
+    try:
+        with open(p) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return isinstance(doc, dict) and doc.get("schema") == GSHD_MANIFEST_SCHEMA
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    p = manifest_path_of(path)
+    with open(p) as f:
+        doc = json.load(f)
+    if doc.get("schema") != GSHD_MANIFEST_SCHEMA:
+        raise ValueError(
+            f"{p}: not a GSHD manifest "
+            f"(schema {doc.get('schema')!r}, expected {GSHD_MANIFEST_SCHEMA!r})"
+        )
+    doc["_dir"] = os.path.dirname(os.path.abspath(p))
+    return doc
+
+
+def read_index(manifest: Dict[str, Any]) -> Tuple[np.ndarray, np.ndarray]:
+    """Digest-verified per-sample (num_nodes, num_edges) arrays — the only
+    whole-corpus state the streaming loader keeps in RAM (16 bytes/sample)."""
+    path = os.path.join(manifest["_dir"], manifest["index"]["file"])
+    with open(path, "rb") as f:
+        blob = f.read()
+    header, sections = ckpt_format.decode(blob, path)
+    if header.get("kind") != "gshd-index":
+        raise ckpt_format.CheckpointCorruptError(
+            path, f"not a gshd index (kind={header.get('kind')!r})"
+        )
+    ns = np.frombuffer(sections["ns"], np.int64)
+    es = np.frombuffer(sections["es"], np.int64)
+    if ns.size != int(manifest["num_samples"]) or es.size != ns.size:
+        raise ckpt_format.CheckpointCorruptError(
+            path, "index length does not match the manifest sample count"
+        )
+    return ns, es
+
+
+def shard_offsets(manifest: Dict[str, Any]) -> np.ndarray:
+    """Prefix offsets of each shard's first global sample index (len S+1):
+    global sample ``i`` lives in shard ``searchsorted(offsets, i, 'right')-1``
+    at local position ``i - offsets[sid]``."""
+    sizes = [int(sh["num_samples"]) for sh in manifest["shards"]]
+    out = np.zeros(len(sizes) + 1, np.int64)
+    np.cumsum(sizes, out=out[1:])
+    return out
+
+
+def iter_samples(path: str, limit: Optional[int] = None) -> Iterator[GraphSample]:
+    """Stream every sample in dataset (shard) order — one decoded shard
+    resident at a time. The sequential-scan entry point (conversion checks,
+    batch inference, visualization)."""
+    manifest = read_manifest(path)
+    n = 0
+    for sh in manifest["shards"]:
+        for s in load_shard(os.path.join(manifest["_dir"], sh["file"])):
+            yield s
+            n += 1
+            if limit is not None and n >= limit:
+                return
+
+
+def verify_gshd(path: str) -> Dict[str, Any]:
+    """Full integrity check: per-shard whole-file sha256 vs the manifest,
+    v2 container digests, per-shard sample counts, and the index. Returns a
+    report dict (``ok`` + per-shard verdicts); never raises on corruption."""
+    report: Dict[str, Any] = {"ok": True, "shards": [], "errors": []}
+    try:
+        manifest = read_manifest(path)
+    except Exception as e:  # noqa: BLE001 — verify reports, never raises
+        return {"ok": False, "shards": [], "errors": [f"manifest: {e}"]}
+    total = 0
+    for sh in manifest["shards"]:
+        entry = {"file": sh["file"], "ok": True, "error": None}
+        fpath = os.path.join(manifest["_dir"], sh["file"])
+        try:
+            with open(fpath, "rb") as f:
+                blob = f.read()
+            if _sha256(blob) != sh["sha256"]:
+                raise ckpt_format.CheckpointCorruptError(
+                    fpath, "file sha256 does not match the manifest"
+                )
+            samples = decode_shard(blob, fpath)
+            if len(samples) != int(sh["num_samples"]):
+                raise ckpt_format.CheckpointCorruptError(
+                    fpath,
+                    f"sample count {len(samples)} != manifest "
+                    f"{sh['num_samples']}",
+                )
+            total += len(samples)
+        except Exception as e:  # noqa: BLE001 — collected into the report
+            entry.update(ok=False, error=str(e))
+            report["ok"] = False
+            report["errors"].append(f"{sh['file']}: {e}")
+        report["shards"].append(entry)
+    try:
+        read_index(manifest)
+    except Exception as e:  # noqa: BLE001 — collected into the report
+        report["ok"] = False
+        report["errors"].append(f"index: {e}")
+    if report["ok"] and total != int(manifest["num_samples"]):
+        report["ok"] = False
+        report["errors"].append(
+            f"total samples {total} != manifest {manifest['num_samples']}"
+        )
+    report["num_samples"] = total
+    report["num_shards"] = len(manifest["shards"])
+    return report
+
+
+# ---------------------------------------------------------------- conversion
+def convert_pickle_corpus(
+    pkl_path: str,
+    out_dir: str,
+    config: Optional[Dict[str, Any]] = None,
+    shard_size: int = 256,
+    name: Optional[str] = None,
+) -> str:
+    """Migrate one pickle corpus (the 3-pickle minmax/minmax/dataset layout)
+    to GSHD. With ``config``, the split is run through
+    ``SerializedDataLoader`` first so the shards hold TRAINING-READY samples
+    (edges built, targets packed, features selected) and the streaming loader
+    does no per-epoch preprocessing; without it the raw samples are stored
+    as-is. Returns the manifest path."""
+    import pickle
+
+    with open(pkl_path, "rb") as f:
+        minmax_node_feature = pickle.load(f)
+        minmax_graph_feature = pickle.load(f)
+        dataset = pickle.load(f)
+    if config is not None:
+        from ..preprocess.serialized_loader import SerializedDataLoader
+
+        dataset = SerializedDataLoader(config).load_serialized_data(
+            dataset_path=pkl_path
+        )
+    return write_gshd(
+        out_dir,
+        dataset,
+        shard_size=shard_size,
+        name=name or os.path.splitext(os.path.basename(pkl_path))[0],
+        minmax_node_feature=minmax_node_feature,
+        minmax_graph_feature=minmax_graph_feature,
+    )

@@ -326,8 +326,24 @@ def compute_pad_sizes(
     this dataset fits one compiled shape: a worst-case batch is the ``batch_size``
     largest graphs. ``ladder_step`` picks the round-up ladder (see
     ``round_up_pow2``)."""
-    nodes = sorted((s.num_nodes for s in graphs), reverse=True)[:batch_size]
-    edges = sorted((s.num_edges for s in graphs), reverse=True)[:batch_size]
+    return compute_pad_sizes_from_counts(
+        [s.num_nodes for s in graphs],
+        [s.num_edges for s in graphs],
+        batch_size,
+        ladder_step=ladder_step,
+    )
+
+
+def compute_pad_sizes_from_counts(
+    ns, es, batch_size: int, ladder_step: str = "pow2"
+) -> Tuple[int, int, int]:
+    """``compute_pad_sizes`` from per-sample (num_nodes, num_edges) count
+    arrays alone — the form the loaders use (their ``_ns``/``_es`` arrays are
+    the single source of truth) and the only form the out-of-core streaming
+    loader CAN use: its pad shapes come from the GSHD index without decoding
+    a single shard (docs/DATA_PLANE.md)."""
+    nodes = sorted((int(n) for n in ns), reverse=True)[:batch_size]
+    edges = sorted((int(e) for e in es), reverse=True)[:batch_size]
     n_pad = round_up_pow2(sum(nodes) + 1, mode=ladder_step)
     e_pad = round_up_pow2(max(sum(edges), 1) + 1, mode=ladder_step)
     return n_pad, e_pad, batch_size + 1

@@ -22,7 +22,11 @@ threads over the loopback harness; the spawn path rides the same
   window pads with empty (all-masked) batches instead of wrapping — the
   documented wrap-pad divergence from ``GraphDataLoader``'s round-robin
   dealing (an elastic epoch must conserve the sample multiset exactly; a
-  wrap would double-count tail samples every transition).
+  wrap would double-count tail samples every transition). The same dealing
+  contract holds for an out-of-core GSHD corpus: ``StreamingGraphLoader``
+  (datasets/stream.py, docs/DATA_PLANE.md) exposes identical
+  ``num_shards``/``shard_rank`` views and a live ``reshard()`` for world
+  transitions — elastic training never requires the corpus in host RAM.
 * :class:`ElasticTrainer` — the world-transition protocol. On a membership
   change within ``[min_workers, max_workers]``: quiesce at the next step
   boundary, checkpoint through the existing v2 layer (atomic, digest

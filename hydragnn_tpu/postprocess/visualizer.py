@@ -13,9 +13,11 @@ outputs where the reference works at all, no crash where it would."""
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import pickle
+import warnings
 from typing import List, Optional, Sequence
 
 import matplotlib
@@ -23,6 +25,32 @@ import matplotlib
 matplotlib.use("Agg")
 import matplotlib.pyplot as plt
 import numpy as np
+
+
+_pickle_history_warned = False
+
+
+def load_history(output_dir: str) -> dict:
+    """Read a run's loss-history sidecar: ``history_loss.json``, falling back
+    to the retired ``history_loss.pkl`` for one release (one-time
+    DeprecationWarning, mirroring the pickle-corpus and v1-checkpoint read
+    paths)."""
+    json_path = os.path.join(output_dir, "history_loss.json")
+    if os.path.isfile(json_path):
+        with open(json_path) as f:
+            return json.load(f)
+    pkl_path = os.path.join(output_dir, "history_loss.pkl")
+    global _pickle_history_warned
+    if not _pickle_history_warned:
+        _pickle_history_warned = True
+        warnings.warn(
+            "reading the pickled loss-history sidecar is deprecated — "
+            "re-running training writes history_loss.json instead",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+    with open(pkl_path, "rb") as f:
+        return pickle.load(f)
 
 
 def _identity_line(ax):
@@ -334,10 +362,16 @@ class Visualizer:
 
     # ----------------------------------------------------------- loss history
     def plot_history(self, history: dict, task_weights=None, task_names=None):
-        """Pickle the history dict + plot total and per-task train/val/test
-        curves (reference visualizer.py:626-688, history_loss.pckl)."""
-        with open(os.path.join(self.output_dir, "history_loss.pkl"), "wb") as f:
-            pickle.dump(history, f)
+        """Write the history dict sidecar + plot total and per-task
+        train/val/test curves (reference visualizer.py:626-688). The sidecar
+        is JSON (``history_loss.json``) — bare pickle is write-retired;
+        :func:`load_history` keeps pickle read-compat for one release."""
+        doc = {
+            k: (np.asarray(v).tolist() if not isinstance(v, (int, float)) else v)
+            for k, v in history.items()
+        }
+        with open(os.path.join(self.output_dir, "history_loss.json"), "w") as f:
+            json.dump(doc, f, sort_keys=True)
 
         task_train = np.atleast_2d(np.asarray(history["task_loss_train"], dtype=np.float64))
         task_val = np.atleast_2d(np.asarray(history["task_loss_val"], dtype=np.float64))

@@ -22,7 +22,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..graphs.batch import GraphBatch
-from ..graphs.collate import GraphArena, compute_pad_sizes
+from ..graphs.collate import GraphArena, compute_pad_sizes_from_counts
 from ..graphs.packing import PackCaps, SizeHistogram, first_fit_decreasing
 from ..graphs.sample import GraphSample
 
@@ -226,7 +226,7 @@ class GraphDataLoader:
     def _build_buckets(self, num_buckets: int) -> None:
         """Partition dataset indices into node-count quantile buckets, each
         with its own static pad shape."""
-        n = len(self.dataset)
+        n = int(self._ns.size)
         if n == 0:
             self._buckets = []
             self._bucket_pads = []
@@ -251,9 +251,13 @@ class GraphDataLoader:
         # and num_buckets=1 iteration order is exactly dataset order (the
         # eval-loader guarantee documented in load_data.create_dataloaders).
         self._buckets = [np.sort(b) for b in buckets]
+        # Pad shapes from the count arrays alone (not the sample objects):
+        # the streaming subclass (datasets/stream.py) shares this method with
+        # nothing but the GSHD index in RAM.
         self._bucket_pads = [
-            compute_pad_sizes(
-                [self.dataset[i] for i in b],
+            compute_pad_sizes_from_counts(
+                self._ns[b],
+                self._es[b],
                 self.batch_size,
                 ladder_step=self.ladder_step,
             )

@@ -21,6 +21,15 @@ from .splitting import split_dataset
 
 
 def dataset_loading_and_splitting(config: Dict):
+    # Streaming data plane (docs/DATA_PLANE.md): when every split path is a
+    # GSHD dataset, nothing is materialized in host RAM — the loaders stream
+    # shards through the decode-ahead ring. This branch must precede the
+    # raw/pickle plumbing below, which assumes pickle-era paths.
+    paths = config["Dataset"]["path"]
+    from ..datasets.shards import is_gshd_path
+
+    if "total" not in paths and all(is_gshd_path(p) for p in paths.values()):
+        return create_streaming_dataloaders(config)
     if not list(config["Dataset"]["path"].values())[0].endswith(".pkl"):
         transform_raw_data_to_serialized(config["Dataset"])
     if "total" in config["Dataset"]["path"].keys():
@@ -115,6 +124,51 @@ def create_dataloaders(trainset, valset, testset, batch_size, num_buckets=1,
     return train_loader, val_loader, test_loader, sampler_list
 
 
+def create_streaming_dataloaders(config: Dict):
+    """Three StreamingGraphLoaders over GSHD split datasets — the out-of-core
+    analog of ``create_dataloaders``, with identical split/sharding/knob
+    semantics (global batch divided across processes, train-only buckets/
+    packing/reshuffle, eval loaders in exact dataset order). Corruption
+    handling is shard-granular (``Dataset.skip_budget`` counts shards);
+    ``Training.faults`` corrupt_sample injection is an in-memory-loader drill
+    and does not apply — on-disk corruption is drilled by flipping real shard
+    bytes (benchmarks/stream_bench.py)."""
+    from ..datasets.stream import StreamingGraphLoader
+
+    world_size, rank = get_comm_size_and_rank()
+    batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
+    shard_batch = max(1, -(-batch_size // world_size))
+    if shard_batch * world_size != batch_size:
+        print(
+            f"WARNING: batch_size {batch_size} is not divisible by "
+            f"{world_size} processes; using {shard_batch}/process "
+            f"(effective global batch {shard_batch * world_size})"
+        )
+    ds = config["Dataset"]
+    reshuffle = config["NeuralNetwork"]["Training"].get("reshuffle", "sample")
+    loaders = []
+    for split, shuffle in (("train", True), ("validate", False), ("test", False)):
+        loaders.append(
+            StreamingGraphLoader(
+                ds["path"][split],
+                batch_size=shard_batch,
+                shuffle=shuffle,
+                num_shards=world_size,
+                shard_rank=rank,
+                num_buckets=ds.get("num_buckets", 1) if shuffle else 1,
+                reshuffle=reshuffle if shuffle else "sample",
+                skip_budget=ds.get("skip_budget", 0),
+                packing=bool(ds.get("packing", False)) if shuffle else False,
+                ladder_step=ds.get("ladder_step", "pow2"),
+                ring_depth=ds.get("ring_depth", 2),
+                resident_shards=ds.get("resident_shards", 8),
+            )
+        )
+    train_loader, val_loader, test_loader = loaders
+    sampler_list = loaders if world_size > 1 else []
+    return train_loader, val_loader, test_loader, sampler_list
+
+
 def load_train_val_test_sets(config: Dict):
     timer = Timer("load_data")
     timer.start()
@@ -155,6 +209,9 @@ def total_to_train_val_test_pkls(config: Dict):
             f"{os.environ['SERIALIZED_DATA_PATH']}/serialized_dataset/"
             f"{config['Dataset']['name']}.pkl"
         )
+    from .serialized_loader import warn_pickle_corpus_once
+
+    warn_pickle_corpus_once()
     with open(file_dir, "rb") as f:
         minmax_node_feature = pickle.load(f)
         minmax_graph_feature = pickle.load(f)

@@ -13,6 +13,7 @@ instead, which our DataLoader does).
 from __future__ import annotations
 
 import pickle
+import warnings
 from typing import List, Sequence
 
 import numpy as np
@@ -20,6 +21,28 @@ from sklearn.model_selection import StratifiedShuffleSplit
 
 from ..graphs.sample import GraphSample
 from .graph_build import add_edge_lengths, compute_edges, normalize_rotation
+
+
+_pickle_warned = False
+
+
+def warn_pickle_corpus_once() -> None:
+    """One-time DeprecationWarning for the raw-pickle corpus read path
+    (mirrors the v1-checkpoint read precedent in checkpoint/io.py): pickle
+    corpora still load this release, but GSHD is the supported data plane —
+    it is digest-verified, sharded, and streamable (docs/DATA_PLANE.md)."""
+    global _pickle_warned
+    if _pickle_warned:
+        return
+    _pickle_warned = True
+    from ..datasets.shards import CONVERT_CMD
+
+    warnings.warn(
+        "reading a raw-pickle dataset corpus is deprecated — migrate to the "
+        f"GSHD streaming format with `{CONVERT_CMD}` (docs/DATA_PLANE.md)",
+        DeprecationWarning,
+        stacklevel=3,
+    )
 
 
 class SerializedDataLoader:
@@ -54,6 +77,7 @@ class SerializedDataLoader:
         assert len(self.graph_feature_name) == len(self.graph_feature_col)
 
     def load_serialized_data(self, dataset_path: str) -> List[GraphSample]:
+        warn_pickle_corpus_once()
         with open(dataset_path, "rb") as f:
             _ = pickle.load(f)
             _ = pickle.load(f)

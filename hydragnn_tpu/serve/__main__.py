@@ -5,6 +5,10 @@ ladder, and serves /predict, /healthz, /metrics until interrupted.
 
 ``python -m hydragnn_tpu.serve router ...`` starts the multi-replica front
 router instead (hydragnn_tpu/route/, docs/SERVING.md "Multi-replica tier").
+
+``python -m hydragnn_tpu.serve batch ...`` runs offline batch inference over
+a GSHD corpus — streams shards through the packed bucket ladder and writes
+digest-verified prediction shards (serve/batch.py, docs/DATA_PLANE.md).
 """
 
 from __future__ import annotations
@@ -145,6 +149,89 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def build_batch_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.serve batch",
+        description="Offline batch inference over a GSHD streaming corpus.",
+    )
+    ap.add_argument("--config", required=True,
+                    help="COMPLETED config JSON (logs/<name>/config.json)")
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--ckpt-format", choices=("auto", "native", "torch"),
+                    default="auto")
+    ap.add_argument("--dataset", required=True,
+                    help="GSHD dataset directory (or its manifest JSON)")
+    ap.add_argument("--out", required=True,
+                    help="output directory for prediction shards + manifest")
+    ap.add_argument("--chunk-size", type=int, default=64,
+                    help="graphs per predict() call (default 64)")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="stop after N samples (spot-check a campaign)")
+    ap.add_argument("--skip-budget", type=int, default=0,
+                    help="corrupt input shards tolerated (skipped loudly)")
+    ap.add_argument("--max-batch-graphs", type=int, default=32)
+    ap.add_argument("--max-delay-ms", type=float, default=0.0,
+                    help="micro-batch flush delay; 0 = flush greedily "
+                    "(offline work has no latency SLO)")
+    ap.add_argument("--queue-limit", type=int, default=256)
+    ap.add_argument("--bucket-ladder", default="")
+    ap.add_argument("--max-ladder-rungs", type=int, default=4)
+    ap.add_argument("--packing", action="store_true")
+    ap.add_argument("--ladder-step", choices=("pow2", "mult64"),
+                    default="pow2")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR")
+    ap.add_argument("--no-warmup", action="store_true")
+    return ap
+
+
+def batch_main(argv) -> int:
+    args = build_batch_parser().parse_args(argv)
+    from ..analysis.contracts import gate_config
+
+    ladder = (
+        parse_ladder(args.bucket_ladder, max_rungs=args.max_ladder_rungs)
+        if args.bucket_ladder
+        else None
+    )
+    gate_config(args.config, mode="serving", bucket_ladder=ladder)
+    engine = InferenceEngine.from_config(
+        args.config,
+        checkpoint=args.ckpt,
+        checkpoint_format=args.ckpt_format,
+        max_batch_graphs=args.max_batch_graphs,
+        max_delay_ms=args.max_delay_ms,
+        queue_limit=args.queue_limit,
+        bucket_ladder=ladder,
+        warmup=not args.no_warmup,
+        packing=args.packing,
+        ladder_step=args.ladder_step,
+        compile_cache=args.compile_cache,
+    )
+    from .batch import run_batch_inference
+
+    try:
+        manifest = run_batch_inference(
+            engine,
+            args.dataset,
+            args.out,
+            chunk_size=args.chunk_size,
+            limit=args.limit,
+            skip_budget=args.skip_budget,
+        )
+    finally:
+        engine.close()
+    gps = manifest["graphs_per_sec"]
+    print(
+        f"batch inference: {manifest['num_samples']} graphs in "
+        f"{manifest['wall_s']:.2f}s "
+        f"({gps:.1f} graphs/s)" if gps else "batch inference: 0 graphs",
+        flush=True,
+    )
+    if manifest["skipped_shards"]:
+        print(f"skipped corrupt shards: {len(manifest['skipped_shards'])}")
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -154,6 +241,8 @@ def main(argv=None) -> int:
         from ..route.__main__ import main as router_main
 
         return router_main(argv[1:])
+    if argv and argv[0] == "batch":
+        return batch_main(argv[1:])
     args = build_parser().parse_args(argv)
     # Static contract gate (docs/STATIC_ANALYSIS.md): a broken completed
     # config or an infeasible/unparseable bucket ladder — including the

@@ -1,0 +1,194 @@
+"""Offline batch inference over a GSHD corpus (docs/SERVING.md "Batch
+inference", docs/DATA_PLANE.md) — the screening-campaign entry point::
+
+    python -m hydragnn_tpu.serve batch --config c.json --dataset <gshd_dir> \\
+        --out preds/ [--ckpt ...] [--bucket-ladder ...] [--limit N]
+
+The corpus streams one shard at a time through the engine's packed bucket
+ladder (never materialized whole), and predictions are written as
+digest-verified shards aligned 1:1 with the input shards — prediction shard
+``k`` holds exactly the outputs for input shard ``k``, in sample order, so a
+campaign can be resumed, spot-checked, or joined back to its inputs by
+index. The headline metric is graphs/s end-to-end (decode + packing +
+device + writeback).
+
+A corrupt input shard costs that shard, loudly, never the campaign: it is
+recorded in the prediction manifest's ``skipped_shards`` (with the decode
+error) up to ``skip_budget`` shards, and its aligned prediction shard is
+simply absent.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import msgpack
+import numpy as np
+
+from ..checkpoint import format as ckpt_format
+from ..checkpoint.io import atomic_write_json, write_checkpoint_blob
+from ..datasets import shards as gshd
+from ..graphs.sample import GraphSample
+
+PRED_MANIFEST_NAME = "gshd_predictions.json"
+
+
+def encode_pred_shard(preds: List[List[np.ndarray]]) -> bytes:
+    """Encode one shard's predictions (per-sample per-head arrays) into a v2
+    container: one section per head, concatenated raveled bytes + per-sample
+    shapes in the meta section — the same exact-encoding scheme as GSHD
+    sample fields."""
+    num_heads = len(preds[0]) if preds else 0
+    sections: Dict[str, Optional[bytes]] = {}
+    heads_meta: Dict[str, Any] = {}
+    for h in range(num_heads):
+        arrays = [np.asarray(p[h]) for p in preds]
+        dtype = arrays[0].dtype
+        shapes = []
+        chunks = []
+        for a in arrays:
+            if a.dtype != dtype:
+                a = a.astype(dtype)
+            shapes.append(list(a.shape))
+            chunks.append(np.ascontiguousarray(a).tobytes())
+        heads_meta[f"head{h}"] = {"dtype": dtype.str, "shapes": shapes}
+        sections[f"head{h}"] = b"".join(chunks)
+    sections["meta"] = msgpack.packb(
+        {
+            "schema_version": gshd.GSHD_SCHEMA_VERSION,
+            "num_samples": len(preds),
+            "num_heads": num_heads,
+            "heads": heads_meta,
+        },
+        use_bin_type=True,
+    )
+    return ckpt_format.encode(
+        sections,
+        header={
+            "kind": "gshd-pred",
+            "schema_version": gshd.GSHD_SCHEMA_VERSION,
+            "num_samples": len(preds),
+        },
+    )
+
+
+def decode_pred_shard(
+    blob: bytes, path: str = "<bytes>"
+) -> List[List[np.ndarray]]:
+    """Digest-verify + decode one prediction shard back to per-sample
+    per-head arrays."""
+    header, sections = ckpt_format.decode(blob, path)
+    if header.get("kind") != "gshd-pred":
+        raise ckpt_format.CheckpointCorruptError(
+            path, f"not a gshd prediction shard (kind={header.get('kind')!r})"
+        )
+    meta = msgpack.unpackb(sections["meta"], raw=False, strict_map_key=False)
+    out: List[List[np.ndarray]] = [[] for _ in range(int(meta["num_samples"]))]
+    for h in range(int(meta["num_heads"])):
+        hmeta = meta["heads"][f"head{h}"]
+        flat = np.frombuffer(sections[f"head{h}"], np.dtype(hmeta["dtype"]))
+        off = 0
+        for i, shape in enumerate(hmeta["shapes"]):
+            count = int(np.prod(shape)) if shape else 1
+            out[i].append(flat[off : off + count].reshape(shape))
+            off += count
+    return out
+
+
+def iter_predictions(pred_dir: str):
+    """Stream (sample_index, per-head outputs) over a prediction directory in
+    global sample order (skipped input shards leave index gaps)."""
+    with open(os.path.join(pred_dir, PRED_MANIFEST_NAME)) as f:
+        import json
+
+        manifest = json.load(f)
+    for sh in manifest["shards"]:
+        with open(os.path.join(pred_dir, sh["file"]), "rb") as f:
+            blob = f.read()
+        preds = decode_pred_shard(blob, sh["file"])
+        base = int(sh["base"])
+        for i, p in enumerate(preds):
+            yield base + i, p
+
+
+def run_batch_inference(
+    engine,
+    dataset_path: str,
+    out_dir: str,
+    chunk_size: int = 64,
+    limit: Optional[int] = None,
+    skip_budget: int = 0,
+    timeout: Optional[float] = 300.0,
+) -> Dict[str, Any]:
+    """Stream a GSHD corpus through ``engine.predict`` and write prediction
+    shards + manifest to ``out_dir``. Returns the manifest dict (including
+    the ``graphs_per_sec`` headline). ``limit`` bounds the campaign to the
+    first N samples (still shard-aligned); ``chunk_size`` is the per-call
+    graph count (clamped to the engine's queue limit)."""
+    manifest = gshd.read_manifest(dataset_path)
+    os.makedirs(out_dir, exist_ok=True)
+    chunk = max(1, min(int(chunk_size), int(getattr(engine, "queue_limit", chunk_size))))
+    pred_shards: List[Dict[str, Any]] = []
+    skipped: List[Dict[str, Any]] = []
+    done = 0
+    num_heads = None
+    t0 = time.perf_counter()
+    for sid, sh in enumerate(manifest["shards"]):
+        if limit is not None and done >= limit:
+            break
+        path = os.path.join(manifest["_dir"], sh["file"])
+        try:
+            samples: List[GraphSample] = gshd.load_shard(path)
+        except ckpt_format.CheckpointCorruptError as e:
+            skipped.append({"file": sh["file"], "error": e.reason})
+            print(
+                f"WARNING: skipping corrupt input shard {sh['file']} "
+                f"({e.reason})"
+            )
+            if len(skipped) > skip_budget:
+                raise RuntimeError(
+                    f"batch inference: {len(skipped)} corrupt input shard(s) "
+                    f"> skip_budget={skip_budget} — "
+                    + "; ".join(f"{s['file']}: {s['error']}" for s in skipped)
+                ) from e
+            continue
+        if limit is not None:
+            samples = samples[: max(0, limit - done)]
+        preds: List[List[np.ndarray]] = []
+        for start in range(0, len(samples), chunk):
+            preds.extend(
+                engine.predict(samples[start : start + chunk], timeout=timeout)
+            )
+        if preds:
+            num_heads = len(preds[0])
+        blob = encode_pred_shard(preds)
+        fname = f"pred-{sid:05d}.gshd"
+        write_checkpoint_blob(os.path.join(out_dir, fname), blob)
+        pred_shards.append(
+            {
+                "file": fname,
+                "source": sh["file"],
+                "base": int(gshd.shard_offsets(manifest)[sid]),
+                "num_samples": len(preds),
+                "bytes": len(blob),
+                "sha256": gshd._sha256(blob),
+            }
+        )
+        done += len(preds)
+    wall = time.perf_counter() - t0
+    pred_manifest: Dict[str, Any] = {
+        "schema": gshd.GSHD_PRED_SCHEMA,
+        "schema_version": gshd.GSHD_SCHEMA_VERSION,
+        "source_dataset": manifest["name"],
+        "source_manifest": gshd.manifest_path_of(dataset_path),
+        "num_samples": done,
+        "num_heads": num_heads,
+        "shards": pred_shards,
+        "skipped_shards": skipped,
+        "wall_s": wall,
+        "graphs_per_sec": (done / wall) if wall > 0 else None,
+    }
+    atomic_write_json(os.path.join(out_dir, PRED_MANIFEST_NAME), pred_manifest)
+    return pred_manifest

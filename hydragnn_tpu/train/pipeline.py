@@ -27,7 +27,11 @@ time rather than the (async) dispatch time. Those seconds land in
 Consumers: every epoch-level TrainingDriver path (train_epoch, the chunked
 scan, evaluate) AND the online inference engine (serve/engine.py), whose
 micro-batcher generator runs as the host stage and whose dispatch thread is
-the consumer — the serving path gets the same batch-k+1-commits-while-k-
+the consumer. An out-of-core corpus composes transparently: a
+``StreamingGraphLoader`` (datasets/stream.py, docs/DATA_PLANE.md) iterated
+by thread 1 adds its shard-prefetch ring as a stage 0 — disk I/O + decode
+overlap collation, which overlaps transfer, which overlaps compute — the
+serving path gets the same batch-k+1-commits-while-k-
 computes overlap as a training epoch.
 """
 

@@ -215,11 +215,16 @@ def update_config(config, train_loader, val_loader, test_loader):
 
 # ------------------------------------------------------------------- minmax
 def _serialized_dataset_path(config) -> str:
-    """Where the pickled min/max tables live: the configured .pkl directly, or
-    the serialized dataset derived from SERIALIZED_DATA_PATH + dataset name
-    (the train shard when the config has per-split paths)."""
+    """Where the min/max tables live: a GSHD dataset's manifest (train split
+    preferred), the configured .pkl directly, or the serialized dataset
+    derived from SERIALIZED_DATA_PATH + dataset name (the train shard when
+    the config has per-split paths)."""
+    from ..datasets.shards import is_gshd_path
+
     paths = config["Dataset"]["path"]
     first = next(iter(paths.values()))
+    if is_gshd_path(first):
+        return paths.get("train", first)
     if first.endswith(".pkl"):
         return first
     stem = config["Dataset"]["name"] + ("" if "total" in paths else "_train")
@@ -230,9 +235,26 @@ def _serialized_dataset_path(config) -> str:
 
 def update_config_minmax(dataset_path: str, config: Dict[str, Any]):
     """Fill x_minmax/y_minmax from the per-feature min/max tables pickled
-    ahead of the serialized dataset samples."""
-    with open(dataset_path, "rb") as f:
-        tables = {"node": pickle.load(f), "graph": pickle.load(f)}
+    ahead of the serialized dataset samples — or, for a GSHD dataset, from
+    the tables the conversion preserved in the manifest."""
+    from ..datasets.shards import is_gshd_path, read_manifest
+
+    if is_gshd_path(dataset_path):
+        import numpy as np
+
+        manifest = read_manifest(dataset_path)
+        node = manifest.get("minmax_node_feature")
+        graph = manifest.get("minmax_graph_feature")
+        if node is None or graph is None:
+            raise ValueError(
+                f"{dataset_path}: manifest has no min/max tables — re-run "
+                "`python -m hydragnn_tpu.datasets convert` from the pickle "
+                "corpus to carry them over"
+            )
+        tables = {"node": np.asarray(node), "graph": np.asarray(graph)}
+    else:
+        with open(dataset_path, "rb") as f:
+            tables = {"node": pickle.load(f), "graph": pickle.load(f)}
     config["x_minmax"] = [
         tables["node"][:, i].tolist() for i in config["input_node_features"]
     ]

@@ -451,3 +451,61 @@ def pytest_committed_elastic_artifact_readable():
     assert blk["drills_passed"] == blk["drills_total"] == 4
     assert blk["convergence_parity_ok"] is True
     assert blk["warm_restart_ok"] is True
+
+
+def pytest_last_known_stream_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_stream
+
+    real = {
+        "metric": "stream_ab",
+        "value": 2776.1,
+        "unit": "batch_infer_graphs_per_sec",
+        "ok": True,
+        "train_ab": {
+            "params_bit_exact": True,
+            "streamed_over_inmemory_wall": 1.02,
+        },
+        "drills_passed": 2,
+        "drills_total": 2,
+        "backend": "cpu",
+    }
+    (tmp_path / "STREAM_r06.json").write_text(json.dumps(real))
+    # A failed round (ok false) is never "last known".
+    (tmp_path / "STREAM_r07.json").write_text(
+        json.dumps({"metric": "stream_ab", "value": 0.0, "ok": False})
+    )
+    now = time.time()
+    os.utime(tmp_path / "STREAM_r06.json", (now - 50, now - 50))
+    os.utime(tmp_path / "STREAM_r07.json", (now - 5, now - 5))
+
+    blk = _last_known_stream(str(tmp_path))
+    assert blk is not None
+    assert blk["value"] == 2776.1
+    assert blk["params_bit_exact"] is True
+    assert blk["streamed_over_inmemory_wall"] == 1.02
+    assert blk["drills_passed"] == 2
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "STREAM_r06.json"
+
+
+def pytest_last_known_stream_none_when_no_measurements(tmp_path):
+    from bench import _last_known_stream
+
+    (tmp_path / "STREAM_bad.json").write_text("{not json")
+    (tmp_path / "STREAM_r05.json").write_text(
+        json.dumps({"ok": True, "value": 1.0})  # no metric field
+    )
+    assert _last_known_stream(str(tmp_path)) is None
+
+
+def pytest_committed_stream_artifact_readable():
+    """The committed STREAM_r* round is a valid last-known block: bit-exact
+    A/B, wall ratio recorded, both drills green."""
+    from bench import _last_known_stream
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_stream(repo)
+    assert blk is not None
+    assert blk["params_bit_exact"] is True
+    assert blk["streamed_over_inmemory_wall"] is not None
+    assert blk["drills_passed"] == blk["drills_total"] == 2
