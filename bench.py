@@ -544,6 +544,32 @@ def _last_known_swap(search_dir: "str | None" = None) -> "dict | None":
     return _latest_artifact_block("SWAP_*.json", extract, search_dir)
 
 
+def _last_known_flywheel(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent completed continuous-learning soak from any committed
+    FLYWHEEL_* artifact — the graftloop analog of ``_last_known_hardware``.
+    A failed ``--flywheel`` round embeds this block with ``provenance:
+    "stale"`` so an rc=1 round still carries the last known soak verdicts."""
+
+    def extract(doc):
+        soak = doc.get("soak") or {}
+        if not doc.get("drills_total") or not soak:
+            return None
+        return {
+            "drills_passed": doc.get("drills_passed"),
+            "drills_total": doc.get("drills_total"),
+            "promotions": (soak.get("counters") or {}).get("promotions"),
+            "rejections": (soak.get("counters") or {}).get("rejections"),
+            "poisoned_never_served": soak.get("poisoned_never_served"),
+            "recompiles_after_warmup": soak.get("recompiles_after_warmup"),
+            "lost_total": soak.get("lost_total"),
+            "zero_version_torn": soak.get("zero_version_torn"),
+            "platform": doc.get("platform"),
+            "device_kind": doc.get("device_kind"),
+        }
+
+    return _latest_artifact_block("FLYWHEEL_*.json", extract, search_dir)
+
+
 def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
     """Most recent completed drill matrix from any committed FAULTS_*
     artifact — the fault-drill analog of ``_last_known_hardware``. A failed
@@ -1920,6 +1946,59 @@ def swap_main() -> int:
         return 1
 
 
+def flywheel_main() -> int:
+    """``python bench.py --flywheel``: run the continuous-learning soak
+    (benchmarks/flywheel_soak.py — serve load + concurrent fine-tuning with
+    shadow-gated auto-promotions, a refused poisoned candidate, a
+    drift-triggered ladder refit + fleet swap, and the kill-during-promotion
+    incarnation drill) and print its block as the round's FLYWHEEL JSON
+    line. Exit 1 when any drill fails; failure embeds the last known soak
+    (stale-labeled), mirroring the other bench arms."""
+    result = {
+        "metric": "flywheel_soak",
+        "value": 0.0,
+        "unit": "drills_passed",
+    }
+    try:
+        import jax
+
+        _with_retries(_probe_device)
+        result["backend"] = jax.default_backend()
+        result["device_kind"] = jax.devices()[0].device_kind
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.flywheel_soak import run_flywheel_benchmark
+
+        block = _with_retries(run_flywheel_benchmark)
+        soak = block["soak"]
+        result["value"] = float(block["drills_passed"])
+        result["drills_passed"] = block["drills_passed"]
+        result["drills_total"] = block["drills_total"]
+        result["promotions"] = (soak.get("counters") or {}).get("promotions")
+        result["rejections"] = (soak.get("counters") or {}).get("rejections")
+        result["poisoned_never_served"] = soak.get("poisoned_never_served")
+        result["recompiles_after_warmup"] = soak.get("recompiles_after_warmup")
+        result["lost_total"] = soak.get("lost_total")
+        result["flywheel"] = block
+        result["retries"] = _RETRIES_USED
+        ok = block["drills_passed"] == block["drills_total"]
+        print(json.dumps(result))
+        return 0 if ok else 1
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        result["retries"] = _RETRIES_USED
+        try:
+            stale = _last_known_flywheel()
+            if stale is not None:
+                result["last_known_flywheel"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+
+
 def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
     'remote_compile: Connection refused') or probe timeouts — retryable;
@@ -2167,6 +2246,8 @@ if __name__ == "__main__":
         sys.exit(router_main())
     if "--swap" in sys.argv:
         sys.exit(swap_main())
+    if "--flywheel" in sys.argv:
+        sys.exit(flywheel_main())
     if "--faults" in sys.argv:
         sys.exit(faults_main())
     if "--packing" in sys.argv:

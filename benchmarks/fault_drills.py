@@ -411,10 +411,147 @@ print("SUPERVISOR_META " + json.dumps(meta))
         }
 
 
+def _flywheel_promote_rollback_drill() -> dict:
+    """Fast promote-and-rollback smoke for the continuous-learning flywheel
+    (CI ``--flywheel`` subset; the full gauntlet lives in
+    benchmarks/flywheel_soak.py). One replica, one genuine candidate
+    auto-promoted through the shadow gate, one wrecked candidate refused
+    and quarantined, then an operator ``rollback()`` restoring the
+    pre-flywheel live — all against the real registry/router/engine
+    stack, no subprocesses."""
+    import glob
+    import tempfile
+
+    from benchmarks.serve_load import (
+        _host_variables,
+        _perturb,
+        _swap_fixture,
+        build_serving_engine,
+    )
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.flywheel import Flywheel, FlywheelConfig
+    from hydragnn_tpu.lifecycle import LifecycleManager
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, engines, graphs, run_dir, vars0 = _swap_fixture(
+            tmp, n_replicas=1
+        )
+        engine = engines[0]
+        shadow, _ = build_serving_engine(model_version="shadow")
+        router = Router(
+            [InProcessReplica("fw-smoke", engine)],
+            health_interval_s=0.1,
+            jitter_seed=0,
+        )
+        fly = None
+        try:
+            initial = registry.live.short
+            manager = LifecycleManager(registry, [engine], router=router)
+            fly = Flywheel(
+                registry,
+                manager,
+                router,
+                shadow,
+                [(g.num_nodes, g.num_edges, 1) for g in graphs],
+                config=FlywheelConfig(
+                    shadow_fraction=1.0,
+                    shadow_tolerance=0.5,
+                    shadow_min_samples=2,
+                    gate_window_s=0.0,
+                    gate_patience_s=60.0,
+                    refit_interval_s=3600.0,
+                ),
+                run_dir=run_dir,
+            )
+            fly.attach()
+
+            def drive(want_state):
+                state = None
+                for i in range(128):
+                    router.predict(
+                        [graphs[i % len(graphs)]], request_id=f"fw-{i}"
+                    )
+                    state = fly.tick()["weights"].get("state")
+                    if state == want_state:
+                        return True
+                return state == want_state
+
+            # Genuine candidate (diff ~1e-2, an order under the 0.5 bound):
+            # the gate must go green and auto-promote.
+            save_model(
+                _perturb(vars0, 1e-3, seed=21), None, registry.name,
+                path=tmp, meta={"epoch": 1}, keep_last_k=3,
+            )
+            promoted = drive("promoted")
+            live_after_promote = registry.live.short
+            # Wrecked candidate (diff orders above the bound): refused and
+            # quarantined, live untouched.
+            save_model(
+                _perturb(vars0, 5.0, seed=22), None, registry.name,
+                path=tmp, meta={"epoch": 2}, keep_last_k=3,
+            )
+            rejected = drive("rejected")
+            live_after_reject = registry.live.short
+            dumps = glob.glob(
+                os.path.join(run_dir, "flightrec_*_flywheel_reject.json")
+            )
+            quarantined = glob.glob(os.path.join(run_dir, "quarantine", "*"))
+            # Operator rollback: previous (= the pre-flywheel live) returns.
+            manager.rollback()
+            counters = fly.report()["counters"]
+            survived = (
+                promoted
+                and rejected
+                and live_after_promote != initial
+                and live_after_reject == live_after_promote
+                and registry.live.short == initial
+                and counters["promotions"] == 1
+                and counters["rejections"] == 1
+                and len(dumps) >= 1
+                and len(quarantined) >= 1
+            )
+            return {
+                "survived": bool(survived),
+                "mechanism": "shadow_gate",
+                "initial": initial,
+                "promoted_to": live_after_promote,
+                "live_after_reject": live_after_reject,
+                "live_after_rollback": registry.live.short,
+                "reject_flight_dumps": len(dumps),
+                "quarantined": len(quarantined),
+                "counters": counters,
+            }
+        finally:
+            if fly is not None:
+                fly.stop()
+            router.close()
+            engine.close()
+            shadow.close()
+
+
 def run_fault_drills(include_supervisor: bool = True, only: "str | None" = None) -> dict:
     from hydragnn_tpu.faults import FaultCounters, FaultPlan
 
     FaultCounters.reset()
+    if only == "flywheel":
+        # The CI smoke (static-analysis workflow --flywheel): one in-process
+        # promote-and-rollback pass through the real shadow gate — no soak,
+        # no subprocess kills (benchmarks/flywheel_soak.py owns those).
+        drills = {
+            "flywheel_promote_rollback": _flywheel_promote_rollback_drill(),
+        }
+        passed = sum(1 for v in drills.values() if v["survived"])
+        return {
+            "metric": "fault_drills",
+            "value": round(passed / len(drills), 4),
+            "unit": "drills_passed_frac",
+            "subset": "flywheel",
+            "drills_passed": passed,
+            "drills_total": len(drills),
+            "drills": drills,
+            "counters": FaultCounters.snapshot(),
+        }
     if only == "checkpoint":
         # The CI subset (static-analysis workflow): the two local checkpoint
         # drills plus the stall/byte-identity split — no subprocess
@@ -567,7 +704,11 @@ def run_fault_drills(include_supervisor: bool = True, only: "str | None" = None)
 
 
 if __name__ == "__main__":
-    only = "checkpoint" if "--checkpoint" in sys.argv else None
+    only = (
+        "checkpoint"
+        if "--checkpoint" in sys.argv
+        else "flywheel" if "--flywheel" in sys.argv else None
+    )
     result = run_fault_drills(
         include_supervisor="--no-supervisor" not in sys.argv, only=only
     )

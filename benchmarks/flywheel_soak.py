@@ -1,0 +1,498 @@
+"""Flywheel soak rig: the compressed train-while-serving drill matrix behind
+``bench.py --flywheel`` (``FLYWHEEL_rNN.json``; docs/FLYWHEEL.md).
+
+One soak closes both of graftloop's feedback loops against live open-loop
+traffic through the front router:
+
+* **Weights loop** — two GENUINE fine-tunes (real AdamW steps from the live
+  weights) are checkpointed mid-load; the flywheel auto-stages each as a
+  registry candidate, arms the router's shadow arm, and auto-promotes on a
+  green tolerance gate — zero lost accepted requests, zero version-torn
+  responses, versions monotonic per replica. Then a POISONED fine-tune
+  (``FaultPlan("poison_labels:...")`` label corruption — finite, validator-
+  undetectable targets) is checkpointed the same way: the shadow gate goes
+  red and the flywheel refuses it (quarantine + ``flywheel_reject`` flight
+  dump); the poisoned version never answers a caller.
+* **Data loop** — the offered traffic's size distribution shifts across a
+  compiled-shape boundary; the windowed histogram-distance detector enters
+  drift (hysteresis-sustained), the flywheel refits the bucket ladder from
+  the drift window and hot-swaps it across the fleet with new rungs warmed
+  through the executable registry — the post-swap serving window is
+  compile-sentinel-clean (``recompiles_after_warmup == 0``).
+
+Plus a kill-during-promotion drill under the supervisor's incarnation
+contract: incarnation 0 is SIGKILLed between fleet weight publication and
+the registry's atomic role install (the role table stays the OLD one,
+never torn); the restart incarnation ``recover()``s the surviving candidate
+role, re-judges it from scratch, and completes the promotion.
+
+Run on CPU this measures control-loop plumbing (staging, gating, atomic
+swaps, drift hysteresis), not TPU latency — the artifact labels the
+platform.
+
+    python benchmarks/flywheel_soak.py [--duration 1.0] [--rps 80] [--out F]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.serve_load import (  # noqa: E402
+    _host_variables,
+    _perturb,
+    _swap_fixture,
+    _version_gates,
+    build_serving_engine,
+    router_open_loop,
+)
+from hydragnn_tpu.utils.artifacts import round_tag  # noqa: E402
+
+
+# ------------------------------------------------------------- fine-tuning
+def fine_tune(
+    vars0: dict,
+    steps: int = 2,
+    lr: float = 1e-4,
+    poison_spec: "str | None" = None,
+    seed: int = 0,
+) -> dict:
+    """A real fine-tune from ``vars0`` on a fresh labeled split: AdamW over
+    the flagship-family model for ``steps`` steps. With ``poison_spec``
+    (a ``FaultPlan`` spec, e.g. ``"poison_labels:frac=1.0:scale=20"``) the
+    split's labels are corrupted first — the resulting weights are the
+    poisoned candidate the shadow gate must refuse."""
+    import __graft_entry__ as ge
+    import jax
+
+    from hydragnn_tpu.faults.plan import FaultPlan
+    from hydragnn_tpu.graphs import collate_graphs
+    from hydragnn_tpu.train import create_train_state, make_train_step
+    from hydragnn_tpu.utils.optimizer import select_optimizer
+
+    rng = np.random.default_rng(seed)
+    graphs = ge._make_graphs(16, rng)
+    if poison_spec:
+        FaultPlan(poison_spec).poison_dataset(graphs)
+    model = ge._build_model(hidden=8, layers=2)
+    batch = collate_graphs(graphs, ge.TYPES, ge.DIMS, edge_dim=1)
+    opt = select_optimizer("AdamW", lr)
+    state = create_train_state(
+        model,
+        {"params": vars0["params"], "batch_stats": vars0.get("batch_stats", {})},
+        opt,
+    )
+    step = make_train_step(model, opt, donate=False)
+    key = jax.random.PRNGKey(seed)
+    for _ in range(steps):
+        state, _metrics = step(state, batch, key)
+    return jax.tree_util.tree_map(
+        np.asarray, {"params": state.params, "batch_stats": state.batch_stats}
+    )
+
+
+def _drive_until(
+    router,
+    graphs,
+    rps: float,
+    predicate,
+    max_s: float = 30.0,
+    chunk_s: float = 0.3,
+    klass: str = "fast",
+) -> "tuple[list, bool]":
+    """Keep offered load flowing in short open-loop chunks until the
+    control-loop ``predicate`` holds (or ``max_s`` elapses) — the soak's
+    'the flywheel acts WHILE traffic flows' shape. Returns (levels, ok)."""
+    levels: list = []
+    t0 = time.perf_counter()
+    while True:
+        levels.append(router_open_loop(router, graphs, rps, chunk_s, klass=klass))
+        if predicate():
+            return levels, True
+        if time.perf_counter() - t0 > max_s:
+            return levels, False
+
+
+# ------------------------------------------------------------------ the soak
+def flywheel_soak_drill(duration_s: float = 1.0, rps: float = 80.0) -> dict:
+    """The compressed soak (see module docstring): serve load + concurrent
+    fine-tuning with two green auto-promotions, one refused poisoned
+    candidate, and one drift-triggered ladder refit + fleet swap."""
+    import tempfile
+
+    import __graft_entry__ as ge
+
+    from hydragnn_tpu.analysis.sentinel import compile_count
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.flywheel import Flywheel, FlywheelConfig
+    from hydragnn_tpu.graphs.packing import fit_ladder
+    from hydragnn_tpu.lifecycle import LifecycleManager
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # The fitted-ladder source distribution: the request pool the fleet
+        # is about to serve (8-24 node graphs — all inside one 64-node
+        # compiled-shape bin; the drift phase moves mass across that bin).
+        pool = ge._make_graphs(64, np.random.default_rng(0))
+        source_rows = [(g.num_nodes, g.num_edges, 1) for g in pool]
+        ladder0 = fit_ladder(source_rows, max_rungs=3)
+        registry, engines, graphs, run_dir, vars0 = _swap_fixture(
+            tmp, n_replicas=2, bucket_ladder=ladder0, packing=True
+        )
+        router = Router(
+            [InProcessReplica(f"replica-{i}", e) for i, e in enumerate(engines)],
+            health_interval_s=0.1,
+            jitter_seed=0,
+        )
+        shadow_engine, _ = build_serving_engine(
+            bucket_ladder=ladder0, packing=True, model_version="shadow"
+        )
+        manager = LifecycleManager(registry, engines, router=router)
+        # Tolerance 0.5 sits an order of magnitude above a genuine small
+        # fine-tune's output delta (~0.1 on this model) and two orders
+        # below the poisoned fine-tune's (~300) — measured, not guessed.
+        config = FlywheelConfig(
+            shadow_fraction=1.0,
+            shadow_tolerance=0.5,
+            shadow_min_samples=4,
+            gate_window_s=0.05,
+            gate_patience_s=30.0,
+            drift_high=0.35,
+            drift_low=0.15,
+            drift_window=3,
+            drift_sustain=2,
+            refit_interval_s=0.1,
+            max_rungs=3,
+            tick_interval_s=0.02,
+        )
+        fly = Flywheel(
+            registry, manager, router, shadow_engine, source_rows,
+            config=config, run_dir=run_dir,
+        )
+        fly.attach().start()
+        try:
+            live0 = registry.live
+            levels = [router_open_loop(router, graphs, rps, duration_s)]
+
+            # --- weights loop, green: two genuine fine-tunes auto-promote.
+            promoted: list = []
+            promotions_ok = True
+            for i, seed in enumerate((11, 12)):
+                cand_vars = fine_tune(vars0, steps=2, lr=1e-4, seed=seed)
+                save_model(
+                    cand_vars, None, registry.name, path=tmp,
+                    meta={"epoch": i + 1}, keep_last_k=3,
+                )
+                want = i + 1
+                chunk, ok = _drive_until(
+                    router, graphs, rps,
+                    lambda: fly.report()["counters"]["promotions"] >= want,
+                )
+                levels += chunk
+                promotions_ok = promotions_ok and ok
+                promoted.append(registry.live.short)
+
+            # --- weights loop, red: the poisoned fine-tune must be refused.
+            bad_vars = fine_tune(
+                vars0, steps=8, lr=0.05, seed=5,
+                poison_spec="poison_labels:frac=1.0:scale=20,seed=5",
+            )
+            save_model(
+                bad_vars, None, registry.name, path=tmp,
+                meta={"epoch": 3}, keep_last_k=3,
+            )
+            chunk, rejected_ok = _drive_until(
+                router, graphs, rps,
+                lambda: fly.report()["counters"]["rejections"] >= 1,
+            )
+            levels += chunk
+            reject_report = fly.report()["last_reject"] or {}
+            poisoned_short = reject_report.get("candidate")
+            reject_dumps = glob.glob(
+                os.path.join(run_dir, "flightrec_*_flywheel_reject.json")
+            )
+            live_after_reject = registry.live.short
+
+            # --- data loop: shift traffic across the 64-node shape bin.
+            big = ge._make_graphs(48, np.random.default_rng(7), n_lo=80, n_hi=120)
+            for g in big:
+                g.y = g.y_loc = None
+            # Gate on ladder_swaps (counted after EVERY engine published),
+            # not ladder_refits (counted before the warms start) — the
+            # post-swap window must begin after the whole fleet swapped.
+            swaps0 = fly.report()["counters"]["ladder_swaps"]
+            drift_levels, drift_ok = _drive_until(
+                router, big, rps,
+                lambda: fly.report()["counters"]["ladder_swaps"]
+                >= swaps0 + len(engines),
+                max_s=60.0,
+                klass="ensemble",  # mid-drift fallback compiles exceed the fast deadline
+            )
+            # Post-swap window: every shape the refitted ladder serves was
+            # warmed inside swap_ladder — the compile sentinel must stay flat.
+            c0 = compile_count()
+            post_swap = router_open_loop(
+                router, big, rps, max(0.5, duration_s / 2), klass="ensemble"
+            )
+            recompiles_after_warmup = compile_count() - c0
+
+            report = fly.report()
+            counters = report["counters"]
+            all_levels = levels + drift_levels + [post_swap]
+            lost_total = sum(lv["lost"] for lv in all_levels)
+            allowed = {live0.short, *promoted}
+            gates = [_version_gates(lv, allowed) for lv in all_levels]
+            served_versions = set()
+            for lv in all_levels:
+                served_versions |= set(lv["version_counts"])
+            poisoned_never_served = (
+                poisoned_short is not None
+                and poisoned_short not in served_versions
+            )
+            ladder_after = [list(r) for r in engines[0]._current_ladder()]
+            ok = (
+                promotions_ok
+                and counters["promotions"] >= 2
+                and rejected_ok
+                and counters["rejections"] == 1
+                and live_after_reject == promoted[-1]
+                and poisoned_never_served
+                and len(reject_dumps) >= 1
+                and reject_report.get("quarantined") is not None
+                and drift_ok
+                and counters["ladder_swaps"] >= len(engines)
+                and recompiles_after_warmup == 0
+                and lost_total == 0
+                and all(g["zero_version_torn"] for g in gates)
+                and all(g["versions_monotonic_per_replica"] for g in gates)
+            )
+            return {
+                "ok": ok,
+                "initial_version": live0.short,
+                "promoted_versions": promoted,
+                "poisoned_version": poisoned_short,
+                "live_after_reject": live_after_reject,
+                "poisoned_never_served": poisoned_never_served,
+                "reject_flight_dumps": [os.path.basename(p) for p in reject_dumps],
+                "quarantined": bool(reject_report.get("quarantined")),
+                "reject_reason": reject_report.get("reason"),
+                "ladder_initial": [list(r) for r in ladder0],
+                "ladder_after_refit": ladder_after,
+                "recompiles_after_warmup": recompiles_after_warmup,
+                "lost_total": lost_total,
+                "zero_version_torn": all(g["zero_version_torn"] for g in gates),
+                "versions_monotonic_per_replica": all(
+                    g["versions_monotonic_per_replica"] for g in gates
+                ),
+                "levels": len(all_levels),
+                "offered_total": sum(lv["offered"] for lv in all_levels),
+                "completed_total": sum(lv["completed"] for lv in all_levels),
+                "post_swap": post_swap,
+                "counters": counters,
+                "drift": report["drift"],
+            }
+        finally:
+            fly.stop()
+            router.close()
+            for e in engines:
+                e.close()
+            shadow_engine.close()
+
+
+# -------------------------------------------------- kill-during-promotion
+# Child incarnation: recover()s the staged candidate into the shadow arm,
+# feeds it mirrored traffic, and ticks until the flywheel promotes.
+# Incarnation 0 installs a SIGKILL at the registry's pre-persist hook AFTER
+# arming — the next role-table persist is commit_promote, so the kill lands
+# between fleet weight publication and the atomic role install. The restart
+# incarnation (HYDRAGNN_RESTART_COUNT=1) re-arms the surviving candidate
+# role and completes the promotion.
+_FLY_KILL_CHILD_SCRIPT = r"""
+import json, os, signal, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+repo, run_dir, name = sys.argv[1], sys.argv[2], sys.argv[3]
+sys.path.insert(0, repo)
+from benchmarks.serve_load import build_serving_engine
+from hydragnn_tpu.flywheel import Flywheel, FlywheelConfig
+from hydragnn_tpu.lifecycle import (
+    LifecycleManager, ModelRegistry, set_pre_persist_hook,
+)
+from hydragnn_tpu.route import InProcessReplica, Router
+restart = int(os.environ.get("HYDRAGNN_RESTART_COUNT", "0") or 0)
+registry = ModelRegistry(run_dir, name)
+live = registry.live
+engine, graphs = build_serving_engine(
+    model_version=live.short if live else "v0"
+)
+shadow, _ = build_serving_engine(model_version="shadow")
+router = Router(
+    [InProcessReplica("replica-0", engine)],
+    health_interval_s=0.1, jitter_seed=0,
+)
+manager = LifecycleManager(registry, [engine], router=router)
+config = FlywheelConfig(
+    shadow_tolerance=0.5, shadow_min_samples=2,
+    gate_window_s=0.0, gate_patience_s=60.0, refit_interval_s=0.1,
+)
+src = [(g.num_nodes, g.num_edges, 1) for g in graphs]
+fly = Flywheel(registry, manager, router, shadow, src,
+               config=config, run_dir=run_dir)
+armed = fly.recover()
+assert armed["state"] == "armed", armed
+if restart == 0:
+    set_pre_persist_hook(
+        lambda doc: os.kill(os.getpid(), signal.SIGKILL)
+    )
+state = None
+for i in range(128):
+    router.predict([graphs[i % len(graphs)]], request_id=f"kd-{i}")
+    state = fly.tick()["weights"]["state"]
+    if state == "promoted":
+        break
+set_pre_persist_hook(None)
+print("FLYKILL " + json.dumps(
+    {"state": registry.state(), "final": state,
+     "counters": fly.report()["counters"]}
+))
+router.close()
+engine.close()
+shadow.close()
+"""
+
+
+def kill_during_promotion_drill() -> dict:
+    """Kill-during-promotion under the incarnation contract: the first
+    child dies mid-``commit_promote`` (fleet swapped, role table not yet
+    flipped — and it must still read as the intact OLD table); the restart
+    child ``recover()``s the candidate and promotes it for real."""
+    import tempfile
+
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.lifecycle import ModelRegistry
+
+    with tempfile.TemporaryDirectory() as tmp:
+        registry, engines, _graphs, run_dir, vars0 = _swap_fixture(
+            tmp, n_replicas=1
+        )
+        for e in engines:  # the children own their engines
+            e.close()
+        live = registry.live
+        save_model(
+            _perturb(vars0, 1e-3, seed=9), None, registry.name,
+            path=tmp, meta={"epoch": 1}, keep_last_k=3,
+        )
+        cand = registry.stage_candidate()
+
+        def child(restart: int):
+            env = dict(os.environ)
+            env["HYDRAGNN_RESTART_COUNT"] = str(restart)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            return subprocess.run(
+                [
+                    sys.executable, "-c", _FLY_KILL_CHILD_SCRIPT,
+                    REPO, run_dir, registry.name,
+                ],
+                env=env, capture_output=True, text=True, timeout=600,
+            )
+
+        first = child(0)
+        killed = first.returncode == -9
+        after_kill = ModelRegistry(run_dir, registry.name).state()["roles"]
+        state_consistent = (
+            after_kill["live"] is not None
+            and after_kill["live"]["version"] == live.version
+            and after_kill["candidate"] is not None
+            and after_kill["candidate"]["version"] == cand.version
+        )
+        second = child(1)
+        resumed = second.returncode == 0 and "FLYKILL " in second.stdout
+        final_roles = ModelRegistry(run_dir, registry.name).state()["roles"]
+        promoted = (
+            final_roles["live"] is not None
+            and final_roles["live"]["version"] == cand.version
+            and final_roles["previous"] is not None
+            and final_roles["previous"]["version"] == live.version
+        )
+        return {
+            "ok": killed and state_consistent and resumed and promoted,
+            "child0_returncode": first.returncode,
+            "killed_mid_promotion": killed,
+            "state_consistent_after_kill": state_consistent,
+            "resumed": resumed,
+            "promoted_after_restart": promoted,
+            "stderr_tail": ""
+            if resumed
+            else (second.stderr or first.stderr)[-400:],
+        }
+
+
+# ---------------------------------------------------------------- artifact
+def run_flywheel_benchmark(
+    duration_s: float = 1.0,
+    rps: float = 80.0,
+    out_path: "str | None" = None,
+) -> dict:
+    """The continuous-learning artifact (``FLYWHEEL_rNN.json``): the
+    compressed soak + the kill-during-promotion drill."""
+    import jax
+
+    block = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": "PNA hidden=8 x2 (graph+node heads)",
+        "offered_graphs_per_sec": rps,
+        "note": "CPU runs measure control-loop plumbing (staging, gating, "
+        "atomic swaps, drift hysteresis), not TPU latency",
+    }
+    block["soak"] = flywheel_soak_drill(duration_s, rps)
+    block["kill_during_promotion_drill"] = kill_during_promotion_drill()
+    drills = [block["soak"], block["kill_during_promotion_drill"]]
+    block["drills_total"] = len(drills)
+    block["drills_passed"] = sum(1 for d in drills if d.get("ok"))
+
+    # graftel census: the flywheel decision trail.
+    from hydragnn_tpu import telemetry
+
+    counts = telemetry.span_counts(telemetry.snapshot_records())
+    block["telemetry"] = {
+        "span_counts": {
+            name: n
+            for name, n in sorted(counts.items())
+            if name.startswith(("flywheel/", "swap/", "serve/ladder_swapped"))
+        }
+    }
+
+    if out_path is None:
+        out_path = os.path.join(REPO, f"FLYWHEEL_r{round_tag()}.json")
+    with open(out_path, "w") as f:
+        json.dump(block, f, indent=2)
+    block["artifact"] = os.path.basename(out_path)
+    return block
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--duration", type=float, default=1.0)
+    ap.add_argument("--rps", type=float, default=80.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    block = run_flywheel_benchmark(
+        duration_s=args.duration, rps=args.rps, out_path=args.out
+    )
+    print(json.dumps(block))
+    return 0 if block["drills_passed"] == block["drills_total"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

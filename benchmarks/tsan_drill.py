@@ -421,6 +421,116 @@ def _stream_drill(tmpdir: str) -> None:
     assert ring.join(30), "shard-prefetch thread leaked past close()"
 
 
+def _flywheel_drill(tmpdir: str) -> None:
+    """graftloop path (ISSUE 18): the flywheel control state under
+    instrumentation — the post-save observer enqueuing from the async
+    checkpoint writer thread while ``tick()`` stages/arms/judges on the
+    main thread (Flywheel._lock), drift-detector state racing ``report()``
+    readers (DriftDetector._lock), and a ladder swap published from a
+    swapper thread racing caller submits and the dispatch thread's
+    per-flush ladder snapshot (yield site ``serve.ladder.pre_publish``
+    widens the warm-to-publish window)."""
+    import threading
+
+    from benchmarks.serve_load import (
+        _host_variables,
+        _perturb,
+        build_serving_engine,
+    )
+    from hydragnn_tpu.checkpoint.async_writer import AsyncCheckpointer
+    from hydragnn_tpu.checkpoint.io import save_model
+    from hydragnn_tpu.flywheel import Flywheel, FlywheelConfig
+    from hydragnn_tpu.lifecycle import LifecycleManager, ModelRegistry
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    engine_kw = dict(
+        hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0,
+        pool_size=_SERVE_REQUESTS,
+    )
+    engine, graphs = build_serving_engine(**engine_kw)
+    shadow, _ = build_serving_engine(model_version="shadow", **engine_kw)
+    router = Router(
+        [InProcessReplica("fly-drill", engine)],
+        health_interval_s=0.05,
+        jitter_seed=0,
+    )
+    fly = None
+    try:
+        host = _host_variables(engine)
+        name = "tsan_fly"
+        save_model(host, None, name, path=tmpdir, keep_last_k=3)
+        registry = ModelRegistry(os.path.join(tmpdir, name), name)
+        registry.set_live()
+        manager = LifecycleManager(registry, [engine], router=router)
+        fly = Flywheel(
+            registry,
+            manager,
+            router,
+            shadow,
+            [(g.num_nodes, g.num_edges, 1) for g in graphs],
+            config=FlywheelConfig(
+                shadow_tolerance=0.5, shadow_min_samples=1,
+                gate_window_s=0.0, gate_patience_s=60.0,
+                refit_interval_s=0.01,
+            ),
+            run_dir=os.path.join(tmpdir, name),
+        )
+        fly.attach()
+        # Candidate observed from the ASYNC writer thread — the post-save
+        # hook's cross-thread enqueue is the point.
+        ac = AsyncCheckpointer()
+        try:
+            ac.save(
+                _perturb(host, 1e-3, seed=1), None, name=name,
+                path=tmpdir, meta={"epoch": 1}, keep_last_k=3,
+            )
+            ac.wait()
+        finally:
+            ac.close()
+
+        # report() readers racing the control tick's lock writes.
+        def reader():
+            for _ in range(16):
+                fly.report()
+                router.shadow_report()
+
+        rt = threading.Thread(target=reader, name="fly-reader", daemon=True)
+        rt.start()
+        state = None
+        for i in range(64):
+            router.predict(
+                [graphs[i % len(graphs)]], request_id=f"fly-drill-{i}"
+            )
+            state = fly.tick()["weights"].get("state")
+            if state in ("promoted", "rejected"):
+                break
+        rt.join(60)
+        assert state in ("promoted", "rejected"), state
+
+        # Ladder swap racing live submits (one extra rung keeps the original
+        # first-fit bucket, so in-flight batches never take the fallback).
+        orig = engine._current_ladder()
+        top = orig[-1] if orig else (128, 512)
+        grown = orig + [(top[0] * 2, top[1] * 2)]
+        futures = [engine.submit(g) for g in graphs[:_SERVE_REQUESTS]]
+        st = threading.Thread(
+            target=lambda: engine.swap_ladder(grown, warm=True),
+            name="ladder-drill",
+            daemon=True,
+        )
+        st.start()
+        for f in futures:
+            f.result(timeout=120)
+        st.join(120)
+        engine.metrics.render_prometheus()  # the /metrics cross-thread read
+    finally:
+        if fly is not None:
+            fly.stop()
+        router.close()
+        engine.close()
+        shadow.close()
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -434,6 +544,7 @@ def run_drill(seed: int) -> dict:
         _mesh_drill()
         _elastic_drill()
         _stream_drill(tmpdir)
+        _flywheel_drill(tmpdir)
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

@@ -35,6 +35,12 @@ Every failure is one actionable line tagged with a stable code:
                     target whose architecture fingerprint mismatches the
                     serving config, rollback with keep_last_k < 2) —
                     docs/SERVING.md "Live model lifecycle"
+  bad-flywheel      continuous-learning flywheel nonsense (auto-promotion
+                    without a positive shadow tolerance, drift thresholds
+                    outside (0, 1) or inverted, refit interval shorter than
+                    the shadow gate window, keep_last_k < 3 with
+                    auto-promotion enabled, flywheel with checkpoint_async
+                    off) — docs/FLYWHEEL.md
   donation-misuse   config requests a donating step that would alias buffers
   shape-mismatch    eval_shape found inconsistent shapes/dtypes end to end
 
@@ -93,6 +99,7 @@ def check_config(
     serve_tolerance: Optional[float] = None,
     router: Optional[Dict[str, Any]] = None,
     lifecycle: Optional[Dict[str, Any]] = None,
+    flywheel: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Validate a training or serving config statically. Returns the report
     dict; with ``strict`` (the default) raises :class:`ConfigContractError`
@@ -110,7 +117,12 @@ def check_config(
     ``lifecycle`` is the graftswap config dict
     (``{"shadow_fraction", "tolerance", "swap_target",
     "expected_fingerprint", "rollback", "keep_last_k"}``); lifecycle
-    nonsense is a ``bad-lifecycle`` finding through this same gate."""
+    nonsense is a ``bad-lifecycle`` finding through this same gate.
+    ``flywheel`` is the graftloop config dict (``FlywheelConfig.to_json()``
+    or the supervisor's flywheel block: ``{"auto_promote",
+    "shadow_tolerance", "drift_high", "drift_low", "refit_interval_s",
+    "gate_window_s", "keep_last_k"}``); flywheel nonsense is a
+    ``bad-flywheel`` finding through this same gate."""
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
@@ -136,6 +148,8 @@ def check_config(
         _check_router(router, bucket_ladder, errors)
     if lifecycle is not None:
         _check_lifecycle(lifecycle, arch, training, completed, errors)
+    if flywheel is not None:
+        _check_flywheel(flywheel, training, errors)
     _check_donation(training, errors)
     _check_aggregation_path(arch, errors)
 
@@ -198,6 +212,7 @@ def gate_config(
     serve_tolerance=None,
     router=None,
     lifecycle=None,
+    flywheel=None,
 ):
     """The ONE entry-point gate shared by run_training / run_prediction /
     serve startup: honors ``HYDRAGNN_CHECK_CONFIG`` (``full`` default,
@@ -218,6 +233,7 @@ def gate_config(
         serve_tolerance=serve_tolerance,
         router=router,
         lifecycle=lifecycle,
+        flywheel=flywheel,
     )
 
 
@@ -803,6 +819,99 @@ def _check_lifecycle(lifecycle, arch, training, completed, errors):
                         "an architecture change needs a replica rebuild",
                     )
                 )
+
+
+def _check_flywheel(flywheel, training, errors):
+    """graftloop config contract (docs/FLYWHEEL.md): a misconfigured
+    flywheel does not fail loudly — it silently promotes garbage (no
+    tolerance), flaps the ladder (inverted thresholds), starves its own
+    shadow gate (refit < gate window), or GC-races its rollback chain
+    (keep_last_k < 3). Each is one actionable ``bad-flywheel`` line before
+    the control thread starts."""
+    import math
+
+    def _num(key):
+        v = flywheel.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        f = float(v)
+        return f if math.isfinite(f) else None
+
+    auto = bool(flywheel.get("auto_promote", True))
+    tol = _num("shadow_tolerance")
+    if auto and (tol is None or tol <= 0):
+        errors.append(
+            (
+                "bad-flywheel",
+                "auto-promotion requires a positive shadow_tolerance — "
+                "without a diff bound the shadow gate has no definition of "
+                "'candidate matches live' and promotion is unguarded; got "
+                f"{flywheel.get('shadow_tolerance')!r}",
+            )
+        )
+    high = _num("drift_high")
+    low = _num("drift_low")
+    for key, val in (("drift_high", high), ("drift_low", low)):
+        if flywheel.get(key) is not None and (
+            val is None or not (0.0 < val < 1.0)
+        ):
+            errors.append(
+                (
+                    "bad-flywheel",
+                    f"{key} must be in (0, 1) — histogram distance is "
+                    "total-variation, so 0 fires on any noise and >= 1 can "
+                    f"never fire; got {flywheel.get(key)!r}",
+                )
+            )
+    if high is not None and low is not None and not (low < high):
+        errors.append(
+            (
+                "bad-flywheel",
+                f"drift thresholds must satisfy low < high (got low={low!r} "
+                f"high={high!r}) — equal or inverted thresholds remove the "
+                "hysteresis band and the refit actuator can flap on "
+                "boundary noise",
+            )
+        )
+    refit = _num("refit_interval_s")
+    gate_w = _num("gate_window_s")
+    if refit is not None and gate_w is not None and refit < gate_w:
+        errors.append(
+            (
+                "bad-flywheel",
+                f"refit_interval_s ({refit!r}) must be >= gate_window_s "
+                f"({gate_w!r}) — re-evaluating drift faster than the shadow "
+                "gate can accumulate samples lets a ladder swap land "
+                "mid-judgement and invalidate the gate's comparisons",
+            )
+        )
+    if auto:
+        k = flywheel.get(
+            "keep_last_k", training.get("checkpoint_keep_last_k")
+        )
+        if isinstance(k, int) and not isinstance(k, bool) and k < 3:
+            errors.append(
+                (
+                    "bad-flywheel",
+                    f"auto-promotion requires checkpoint_keep_last_k >= 3 "
+                    f"(got {k!r}) — live, previous, and the in-flight "
+                    "candidate each need a retained slot or retention GC "
+                    "races the promotion it is feeding",
+                )
+            )
+    ckpt_async = flywheel.get(
+        "checkpoint_async", training.get("checkpoint_async")
+    )
+    if ckpt_async is not None and not ckpt_async:
+        errors.append(
+            (
+                "bad-flywheel",
+                "the flywheel requires checkpoint_async — its staging hook "
+                "rides the async saver's post-save callback, and a "
+                "synchronous save would stall the training step for the "
+                "full stage-and-arm round trip",
+            )
+        )
 
 
 def _check_buckets(config, arch, training, bucket_ladder, mode, errors):

@@ -126,6 +126,30 @@ def load_checkpoint_manifest(name: str, path: str = "./logs/") -> Dict[str, Any]
         return {}
 
 
+def role_pinned_files(run_dir: str, name: str) -> set:
+    """Checkpoint files pinned against retention GC by a ModelRegistry role.
+
+    The lifecycle sidecar (``<name>.lifecycle.json``, written atomically by
+    lifecycle/registry.py) names the files holding the live/candidate/
+    previous roles. Those are promotion/rollback targets: with a flywheel
+    staging a candidate per save, a plain last-k walk would eventually
+    delete the rollback target out from under ``rollback()``. Reading the
+    sidecar here (instead of an in-process pin registry) keeps the pin
+    honest across processes — the trainer prunes, the supervisor promotes,
+    and they only share the run directory. A torn/absent sidecar pins
+    nothing (roles were never assigned, or lifecycle is not in play)."""
+    try:
+        with open(os.path.join(run_dir, name + ".lifecycle.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    pinned = set()
+    for rec in (doc.get("roles") or {}).values():
+        if isinstance(rec, dict) and rec.get("file"):
+            pinned.add(os.path.basename(str(rec["file"])))
+    return pinned
+
+
 def _retain_checkpoints(
     run_dir: str, name: str, latest: str, keep_last_k: int, meta
 ) -> None:
@@ -168,12 +192,23 @@ def _retain_checkpoints(
         }
     )
     entries.sort(key=lambda e: e["serial"])
-    for drop in entries[:-keep_last_k] if keep_last_k > 0 else []:
-        try:
-            os.remove(os.path.join(run_dir, drop["file"]))
-        except OSError:
-            pass
-    entries = entries[-keep_last_k:] if keep_last_k > 0 else entries
+    # Role-pinned files (live/candidate/previous per the lifecycle sidecar)
+    # are exempt from the last-k walk: they stay on disk AND in the manifest
+    # (the fallback chain and registry.versions() walk manifest entries), so
+    # rollback targets survive any number of subsequent saves.
+    if keep_last_k > 0:
+        pinned = role_pinned_files(run_dir, name)
+        kept = entries[-keep_last_k:]
+        for drop in entries[:-keep_last_k]:
+            if drop["file"] in pinned:
+                kept.append(drop)
+                continue
+            try:
+                os.remove(os.path.join(run_dir, drop["file"]))
+            except OSError:
+                pass
+        kept.sort(key=lambda e: e["serial"])
+        entries = kept
     doc = {"name": name, "keep_last_k": keep_last_k, "entries": entries}
     atomic_write_json(_manifest_path(run_dir, name), doc)
 

@@ -17,6 +17,8 @@ Spec grammar (comma-separated entries, driven by ``HYDRAGNN_FAULTS`` or the
     nan_grad@12-14             # ... of fed batches 12..14 (inclusive)
     corrupt_sample:count=3     # NaN-corrupt 3 seeded dataset samples
     corrupt_sample:frac=0.05   # ... or a fraction of the dataset
+    poison_labels:frac=0.5     # silently flip/scale targets of seeded samples
+    poison_labels:count=8:scale=20  # ... fixed count, explicit scale
     slow_collate:ms=40         # sleep 40 ms before yielding every batch
     slow_collate@2:ms=40       # ... only before fed batch 2
     transfer_crash@3           # transfer 3 raises a TRANSIENT error, once
@@ -79,6 +81,7 @@ class FaultPlan:
     KINDS = (
         "nan_grad",
         "corrupt_sample",
+        "poison_labels",
         "slow_collate",
         "transfer_crash",
         "kill",
@@ -99,6 +102,9 @@ class FaultPlan:
         self._ckpt_truncate: Set[int] = set()
         self.corrupt_count = 0
         self.corrupt_frac = 0.0
+        self.poison_count = 0
+        self.poison_frac = 0.0
+        self.poison_scale = 10.0
         self._batch_i = 0
         self._transfer_i = 0
         self._ckpt_save_i = 0
@@ -144,6 +150,13 @@ class FaultPlan:
                 self.corrupt_count = int(kv["count"])
             if "frac" in kv:
                 self.corrupt_frac = float(kv["frac"])
+        elif kind == "poison_labels":
+            if "count" in kv:
+                self.poison_count = int(kv["count"])
+            if "frac" in kv:
+                self.poison_frac = float(kv["frac"])
+            if "scale" in kv:
+                self.poison_scale = float(kv["scale"])
 
     # ------------------------------------------------------------ constructors
     @classmethod
@@ -163,6 +176,8 @@ class FaultPlan:
             or self._ckpt_truncate
             or self.corrupt_count
             or self.corrupt_frac
+            or self.poison_count
+            or self.poison_frac
         )
 
     # ------------------------------------------------------- batch-source hook
@@ -270,4 +285,45 @@ class FaultPlan:
             dataset[i] = self.corrupt(dataset[i])
         if idxs:
             FaultCounters.inc("injected_corrupt_samples", len(idxs))
+        return len(idxs)
+
+    # ---------------------------------------------------- label poisoning
+    def poison_sample_indices(self, n: int) -> Set[int]:
+        """Seeded choice of dataset indices to label-poison (empty when the
+        plan carries no poison_labels entry). A distinct seed stream from
+        the corrupt-sample draw, so the two injections compose."""
+        count = self.poison_count
+        if self.poison_frac:
+            count = max(count, int(round(self.poison_frac * n)))
+        count = min(count, n)
+        if count <= 0:
+            return set()
+        rng = np.random.default_rng(self.seed + 0x9E37)
+        return set(int(i) for i in rng.choice(n, size=count, replace=False))
+
+    def poison(self, sample):
+        """Label-poisoned copy of a GraphSample: finite, plausible-looking
+        features with SCALED+FLIPPED targets. Unlike :meth:`corrupt`'s NaN
+        garbage, nothing here is detectable by a record validator — a
+        fine-tune on poisoned labels converges to confidently-wrong outputs,
+        and only an output-comparison gate (the flywheel's shadow gate,
+        docs/FLYWHEEL.md) can refuse the resulting candidate."""
+        bad = sample.clone()
+        # Only the packed target vector flips; y_loc (the int64 head-offset
+        # index) must stay intact or collation breaks — and a broken record
+        # would be detectable, defeating the point of this fault.
+        if bad.y is not None:
+            arr = np.asarray(bad.y, dtype=np.float32)
+            bad.y = -self.poison_scale * arr - 1.0
+        return bad
+
+    def poison_dataset(self, dataset: list) -> int:
+        """Label-poison the scheduled (seeded) samples IN PLACE; returns how
+        many. The flywheel soak uses this on a fine-tune's training split to
+        manufacture the poisoned candidate the shadow gate must catch."""
+        idxs = self.poison_sample_indices(len(dataset))
+        for i in idxs:
+            dataset[i] = self.poison(dataset[i])
+        if idxs:
+            FaultCounters.inc("injected_poisoned_labels", len(idxs))
         return len(idxs)

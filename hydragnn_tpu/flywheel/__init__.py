@@ -1,0 +1,13 @@
+"""graftloop — the continuous-learning flywheel (docs/FLYWHEEL.md).
+
+Supervisor-mode control loop closing the two feedback loops ROADMAP item 4
+left human-cranked: checkpoints auto-stage as shadow-gated candidates
+(green gate → auto-promotion, red gate → quarantine + ``flywheel_reject``
+flight dump), and serve-traffic size histograms drive drift-triggered
+bucket-ladder refits swapped hot across the fleet.
+"""
+
+from .drift import DriftDetector
+from .loop import Flywheel, FlywheelConfig
+
+__all__ = ["DriftDetector", "Flywheel", "FlywheelConfig"]

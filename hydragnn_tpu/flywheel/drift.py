@@ -1,0 +1,207 @@
+"""Drift detector — the flywheel's data-loop sensor (docs/FLYWHEEL.md).
+
+The serving tier already records every request's size into a
+:class:`~hydragnn_tpu.graphs.packing.SizeHistogram` (serve/metrics.py); the
+ladder the batcher runs on was fitted to SOME observed distribution
+(``fit_ladder``'s input — the "source"). This module closes the sensing
+half of the data loop: a windowed total-variation distance between recent
+traffic and the source distribution (``graphs/packing.histogram_distance``
+— both sides quantized to compiled-shape bins, so only mass that MOVES
+ACROSS a shape boundary registers), pushed through a hysteresis state
+machine so boundary noise cannot flap the expensive actuator (ladder refit
++ fleet-wide swap) on and off.
+
+Hysteresis contract:
+
+* **enter**: the detector reports drift only after ``sustain`` CONSECUTIVE
+  evaluations at distance >= ``high``;
+* **exit**: once drifted, it stays drifted until an evaluation lands below
+  ``low`` (a refit calls :meth:`rebase`, which re-anchors the source to the
+  new ladder's input and resets the machine);
+* the band between ``low`` and ``high`` changes nothing in either state —
+  that dead zone is the no-flap guarantee the tier-1 hysteresis test pins.
+
+Thread-safety: observations arrive from the flywheel control thread while
+``report()`` is read by status surfaces — all mutable state is
+``# guarded-by:``-annotated under one instrumented lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from ..analysis import tsan
+from ..graphs.packing import SizeHistogram, histogram_distance
+
+Rows = List[Tuple[int, int, int]]
+
+
+def _as_rows(hist: "SizeHistogram | Sequence[Tuple[int, int, int]]") -> Rows:
+    if isinstance(hist, SizeHistogram):
+        return [(n, e, w) for (n, e), w in sorted(hist.graphs.items())]
+    return [(int(n), int(e), int(w)) for n, e, w in hist]
+
+
+class DriftDetector:
+    """Windowed histogram-distance drift detector with hysteresis."""
+
+    def __init__(
+        self,
+        source: "SizeHistogram | Sequence[Tuple[int, int, int]]",
+        high: float = 0.35,
+        low: float = 0.15,
+        window: int = 4,
+        sustain: int = 3,
+        mode: str = "mult64",
+        step: int = 64,
+        min_nodes: int = 8,
+    ):
+        if not (0.0 < low < high < 1.0):
+            raise ValueError(
+                f"drift thresholds must satisfy 0 < low < high < 1, got "
+                f"low={low!r} high={high!r} (equal thresholds would remove "
+                "the hysteresis band — the no-flap guarantee)"
+            )
+        if window < 1 or sustain < 1:
+            raise ValueError(
+                f"window and sustain must be >= 1, got window={window} "
+                f"sustain={sustain}"
+            )
+        self.high = float(high)
+        self.low = float(low)
+        self.window = int(window)
+        self.sustain = int(sustain)
+        self._quant = {"mode": mode, "step": step, "min_nodes": min_nodes}
+        source_rows = _as_rows(source)
+        if not source_rows:
+            raise ValueError("drift detector needs a non-empty source histogram")
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "DriftDetector._lock"
+        )
+        # The fitted ladder's source observations (rebased on refit).
+        self._source: Rows = source_rows  # guarded-by: self._lock
+        # Sliding window of per-tick observation blocks (each block is the
+        # delta the flywheel pulled from serve metrics since its last tick).
+        self._window: Deque[Rows] = deque(maxlen=self.window)  # guarded-by: self._lock
+        self._over = 0  # consecutive evaluations >= high  # guarded-by: self._lock
+        self._drifted = False  # guarded-by: self._lock
+        self._distance: Optional[float] = None  # last evaluation  # guarded-by: self._lock
+        self.evals_total = 0  # guarded-by: self._lock
+        self.enters_total = 0  # guarded-by: self._lock
+        self.exits_total = 0  # guarded-by: self._lock
+
+    # -------------------------------------------------------------- feeding
+    def observe(
+        self, block: "SizeHistogram | Sequence[Tuple[int, int, int]]"
+    ) -> int:
+        """Append one observation block (a tick's worth of request sizes) to
+        the sliding window; empty blocks are ignored (an idle tick carries
+        no distribution evidence). Returns the block's total weight."""
+        rows = [(n, e, w) for n, e, w in _as_rows(block) if w > 0]
+        weight = sum(w for _n, _e, w in rows)
+        if rows:
+            with self._lock:
+                self._window.append(rows)
+        return weight
+
+    # ----------------------------------------------------------- evaluation
+    def evaluate(self) -> Dict[str, Any]:
+        """One state-machine step: distance of the merged window vs the
+        source, then the hysteresis transition. Returns {distance, drifted,
+        over, transition} where transition is ``"entered"``, ``"exited"``,
+        or None. With an empty window the state is unchanged (distance
+        None): no evidence is not evidence of drift."""
+        with self._lock:
+            merged = [row for block in self._window for row in block]
+            source = self._source
+        if not merged:
+            with self._lock:
+                self.evals_total += 1
+                return {
+                    "distance": None,
+                    "drifted": self._drifted,
+                    "over": self._over,
+                    "transition": None,
+                }
+        d = histogram_distance(source, merged, **self._quant)
+        transition = None
+        with self._lock:
+            self.evals_total += 1
+            self._distance = d
+            if not self._drifted:
+                if d >= self.high:
+                    self._over += 1
+                    if self._over >= self.sustain:
+                        self._drifted = True
+                        self.enters_total += 1
+                        transition = "entered"
+                else:
+                    # Below HIGH resets the sustain count — including the
+                    # hysteresis band: entry requires consecutive evidence.
+                    self._over = 0
+            else:
+                if d < self.low:
+                    self._drifted = False
+                    self._over = 0
+                    self.exits_total += 1
+                    transition = "exited"
+                # low <= d: stays drifted (the band holds the state).
+            out = {
+                "distance": round(d, 6),
+                "drifted": self._drifted,
+                "over": self._over,
+                "transition": transition,
+            }
+        return out
+
+    # --------------------------------------------------------------- refit
+    def window_histogram(self) -> SizeHistogram:
+        """The merged window as a SizeHistogram — what a drift-triggered
+        refit hands to ``fit_ladder`` (the NEW traffic is the new source)."""
+        hist = SizeHistogram()
+        with self._lock:
+            blocks = list(self._window)
+        for block in blocks:
+            for n, e, w in block:
+                hist.record_graph(n, e, w)
+        return hist
+
+    def rebase(
+        self, source: "SizeHistogram | Sequence[Tuple[int, int, int]]"
+    ) -> None:
+        """Re-anchor after a refit: the new ladder's source observations
+        replace the old, the window and the state machine reset — post-swap
+        traffic is judged against what the batcher now runs on."""
+        rows = _as_rows(source)
+        if not rows:
+            raise ValueError("cannot rebase onto an empty source histogram")
+        with self._lock:
+            self._source = rows
+            self._window.clear()
+            self._over = 0
+            self._drifted = False
+            self._distance = None
+
+    # -------------------------------------------------------------- status
+    @property
+    def drifted(self) -> bool:
+        with self._lock:
+            return self._drifted
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "drifted": self._drifted,
+                "distance": self._distance,
+                "over": self._over,
+                "high": self.high,
+                "low": self.low,
+                "window": self.window,
+                "sustain": self.sustain,
+                "window_blocks": len(self._window),
+                "evals_total": self.evals_total,
+                "enters_total": self.enters_total,
+                "exits_total": self.exits_total,
+            }

@@ -1,0 +1,526 @@
+"""graftloop — the continuous-learning flywheel's control loop
+(docs/FLYWHEEL.md; ROADMAP item 4's "train WHILE serving" leg).
+
+One :class:`Flywheel` closes two feedback loops over machinery that already
+exists but was human-cranked:
+
+**Weights loop** (checkpoint → candidate → shadow → promote/reject)::
+
+    trainer save_model()                (checkpoint/io.py, sync or async)
+      → post-save hook                  (observed here, writer thread)
+      → registry.stage_candidate()      (digest-verified identity)
+      → shadow engine loads candidate   (verified load, swap_weights)
+      → router.set_shadow(...)          (sampled live traffic, diff gate)
+      → GREEN  → manager.promote()      (auto-promotion, fleet-wide swap)
+      → RED    → quarantine + flight dump (``flywheel_reject``) + clear
+                 candidate — the poisoned fine-tune NEVER serves a request
+
+**Data loop** (traffic histogram → drift → refit → ladder swap)::
+
+    serve metrics size histograms       (per-tick deltas, all engines)
+      → DriftDetector.observe/evaluate  (hysteresis — drift.py)
+      → sustained drift → fit_ladder()  (graphs/packing.py, window traffic)
+      → engine.swap_ladder(warm=True)   (rungs warmed through graftcache on
+                                         THIS background thread, then one
+                                         atomic publish per engine — zero
+                                         recompiles for already-seen rungs)
+      → detector.rebase(window)         (new ladder's source = new anchor)
+
+Threading model: the post-save hook runs on the checkpoint writer thread
+and only enqueues into a self-synchronizing queue; all decisions execute on
+the single ``hydragnn-flywheel`` control thread (or a test/drill's direct
+``tick()`` calls — the loop IS tick() in a timer). Cross-thread state is
+``# guarded-by:``-annotated; counters live under one instrumented lock.
+
+Refusal-first inheritance: every load rides the registry's verified chain
+(a corrupt candidate is rejected and quarantined, the fleet untouched);
+``manager.promote()`` re-checks the gate and unwinds half-applied fleet
+swaps; a kill between weight publication and role persistence leaves a
+consistent role table (the incarnation contract the kill drill pins).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, List, Optional
+
+from ..analysis import tsan
+from ..graphs.packing import fit_ladder
+from ..lifecycle import (
+    CandidateVerificationError,
+    LifecycleError,
+    LifecycleManager,
+    ModelRegistry,
+    ModelVersion,
+    SwapGateError,
+)
+from ..telemetry import graftel as telemetry
+
+
+@dataclass
+class FlywheelConfig:
+    """Knobs for both loops. The same fields ride the ``flywheel:`` config
+    block ``contracts.check_config`` statically gates (``bad-flywheel``
+    findings) — the runtime re-validates the load-bearing invariants in
+    ``__post_init__`` so a hand-built config cannot skip the contract."""
+
+    # Weights loop.
+    shadow_fraction: float = 1.0
+    shadow_tolerance: float = 1e-5
+    shadow_min_samples: int = 8
+    auto_promote: bool = True
+    gate_window_s: float = 0.5  # min wall a candidate sits armed before verdict
+    gate_patience_s: float = 60.0  # armed longer than this without quota → reject
+    # Data loop.
+    drift_high: float = 0.35
+    drift_low: float = 0.15
+    drift_window: int = 4
+    drift_sustain: int = 3
+    refit_interval_s: float = 1.0  # min seconds between drift evaluations
+    max_rungs: int = 4
+    # Control loop.
+    tick_interval_s: float = 0.05
+    quarantine_dir: str = "quarantine"
+
+    def __post_init__(self) -> None:
+        if self.auto_promote and not (
+            isinstance(self.shadow_tolerance, (int, float))
+            and self.shadow_tolerance > 0
+        ):
+            raise ValueError(
+                "auto-promotion requires a positive shadow tolerance — an "
+                "ungated automatic promotion would serve any candidate"
+            )
+        if not (0.0 < self.drift_low < self.drift_high < 1.0):
+            raise ValueError(
+                f"drift thresholds must satisfy 0 < low < high < 1, got "
+                f"low={self.drift_low!r} high={self.drift_high!r}"
+            )
+        if self.refit_interval_s < self.gate_window_s:
+            raise ValueError(
+                f"refit_interval_s ({self.refit_interval_s}) must be >= "
+                f"gate_window_s ({self.gate_window_s}): a ladder refit "
+                "landing mid-gate-window would change the traffic the "
+                "candidate is being judged on"
+            )
+
+    def to_json(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+class Flywheel:
+    """Supervisor-mode control loop: one registry + manager + router +
+    dedicated shadow engine, two closed feedback loops.
+
+    Parameters
+    ----------
+    registry / manager / router:
+        The graftswap trio (lifecycle/, route/). ``manager.engines`` is the
+        live fleet the data loop reads histograms from and swaps ladders
+        on; the router is where the shadow arm is armed.
+    shadow_engine:
+        A dedicated ``InferenceEngine`` NOT in the router's ring — the
+        candidate's weights are loaded (verified) into it for the shadow
+        arm. Reused across candidates; never serves live traffic.
+    source_hist:
+        The current ladder's source observations (a ``SizeHistogram`` or
+        ``[(nodes, edges, weight)]`` rows) anchoring the drift detector.
+    run_dir:
+        The run directory (defaults to ``registry.run_dir``): quarantine
+        copies and flight-recorder dumps land here.
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        manager: LifecycleManager,
+        router: Any,
+        shadow_engine: Any,
+        source_hist: Any,
+        config: Optional[FlywheelConfig] = None,
+        run_dir: Optional[str] = None,
+    ):
+        from .drift import DriftDetector
+
+        self.registry = registry
+        self.manager = manager
+        self.router = router
+        self.shadow_engine = shadow_engine
+        self.config = config or FlywheelConfig()
+        self.run_dir = run_dir or registry.run_dir
+        self.detector = DriftDetector(
+            source_hist,
+            high=self.config.drift_high,
+            low=self.config.drift_low,
+            window=self.config.drift_window,
+            sustain=self.config.drift_sustain,
+        )
+        self._lock = tsan.instrument_lock(threading.Lock(), "Flywheel._lock")
+        # Checkpoint paths observed by the post-save hook (writer thread) —
+        # a self-synchronizing queue; the control thread drains + coalesces.
+        self._pending: "queue.Queue[str]" = queue.Queue()
+        # Armed-candidate record: {mv, gate, t_armed} while a shadow cycle
+        # is in flight, else None. Written by the control thread, read by
+        # report()/status threads.
+        self._armed: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        # Per-engine cumulative size counts already fed to the detector
+        # (engine id -> {(n, e): count}) — control thread only, but guarded
+        # with the rest so report() can size it consistently.
+        self._hist_seen: Dict[int, Dict[Any, int]] = {}  # guarded-by: self._lock
+        self._counters: Dict[str, int] = {  # guarded-by: self._lock
+            "checkpoints_observed": 0,
+            "candidates_staged": 0,
+            "stage_skipped": 0,
+            "promotions": 0,
+            "rejections": 0,
+            "ladder_refits": 0,
+            "ladder_swaps": 0,
+        }
+        self._last_reject: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        self._last_promote: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        self._last_drift_eval = 0.0  # control thread only  # guarded-by: self._lock, dirty-reads(written and read on the single control thread; the guard covers report())
+        self._prior_hook: Optional[Any] = None
+        self._attached = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ hook wiring
+    def attach(self) -> "Flywheel":
+        """Install the post-save observer, CHAINING any hook already
+        registered (the TrainingDriver wires fault plans through the same
+        module-global slot — both must keep firing)."""
+        from ..checkpoint import io as ckpt_io
+
+        if self._attached:
+            return self
+        self._prior_hook = ckpt_io._post_save_hook
+        ckpt_io.set_post_save_hook(self._on_checkpoint_saved)
+        self._attached = True
+        return self
+
+    def detach(self) -> None:
+        from ..checkpoint import io as ckpt_io
+
+        if self._attached:
+            ckpt_io.set_post_save_hook(self._prior_hook)
+            self._prior_hook = None
+            self._attached = False
+
+    def _on_checkpoint_saved(self, path_name: str) -> None:
+        """Runs on the saver's thread (async writer or trainer) — observe
+        and get out: fault hooks first (they may kill the process; that IS
+        the drill), then enqueue for the control thread."""
+        prior = self._prior_hook
+        if prior is not None:
+            prior(path_name)
+        with self._lock:
+            self._counters["checkpoints_observed"] += 1
+        self._pending.put(path_name)
+        telemetry.event(
+            "flywheel/checkpoint_observed", file=os.path.basename(path_name)
+        )
+
+    # -------------------------------------------------------------- the loop
+    def start(self) -> "Flywheel":
+        """Run the control loop on a background thread (tick() on a timer).
+        Tests and deterministic drills call :meth:`tick` directly instead."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hydragnn-flywheel", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.detach()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must outlive a bad tick
+                telemetry.event("flywheel/tick_error", error=repr(e))
+            self._stop.wait(self.config.tick_interval_s)
+
+    def recover(self) -> Dict[str, Any]:
+        """Restart-incarnation resume (the supervisor's incarnation
+        contract): a candidate role that survived a kill is re-armed instead
+        of forgotten. Judgement restarts from scratch — fresh gate, fresh
+        shadow window — because the pre-kill comparisons died with the
+        process; a half-promoted fleet was already handled by the registry's
+        atomic role table (the kill drill pins this)."""
+        with self._lock:
+            armed = self._armed
+        if armed is not None:
+            return {"state": "armed", "candidate": armed["mv"].short}
+        cand = self.registry.candidate
+        if cand is None:
+            return {"state": "idle"}
+        telemetry.event("flywheel/recovered_candidate", version=cand.short)
+        return self._stage_and_arm(cand.path)
+
+    def tick(self) -> Dict[str, Any]:
+        """One control-loop step: weights loop, then data loop. Idempotent
+        when nothing changed; every decision lands in telemetry + counters."""
+        weights = self._weights_step()
+        data = self._data_step()
+        return {"weights": weights, "data": data}
+
+    # ---------------------------------------------------------- weights loop
+    def _weights_step(self) -> Dict[str, Any]:
+        with self._lock:
+            armed = self._armed
+        if armed is None:
+            path = self._drain_pending()
+            if path is None:
+                return {"state": "idle"}
+            return self._stage_and_arm(path)
+        return self._judge(armed)
+
+    def _drain_pending(self) -> Optional[str]:
+        """Coalesce queued checkpoint paths to the NEWEST (each save
+        overwrites ``<name>.pk`` — staging an older enqueue would just fail
+        identity verification against the file's current bytes)."""
+        path = None
+        while True:
+            try:
+                path = self._pending.get_nowait()
+            except queue.Empty:
+                return path
+
+    def _stage_and_arm(self, path: str) -> Dict[str, Any]:
+        from ..route import InProcessReplica
+
+        try:
+            mv = self.registry.stage_candidate(path)
+        except LifecycleError as e:
+            # Same-as-live (a save with unchanged weights) or unverifiable:
+            # nothing to gate. Not a rejection — no candidate existed.
+            with self._lock:
+                self._counters["stage_skipped"] += 1
+            telemetry.event("flywheel/stage_skipped", reason=repr(e))
+            return {"state": "idle", "staged": False}
+        with self._lock:
+            self._counters["candidates_staged"] += 1
+        try:
+            variables, _meta, loaded = self.registry.load_role(
+                "candidate", self.shadow_engine.variables_template()
+            )
+            self.shadow_engine.swap_weights(variables, loaded.short)
+        except Exception as e:  # noqa: BLE001 — any load/swap refusal (verification, fingerprint, engine state) rejects the candidate, never the loop
+            return self._reject(mv, reason=f"shadow_load_failed: {e!r}")
+        gate = self.router.set_shadow(
+            InProcessReplica(f"shadow-{mv.short}", self.shadow_engine),
+            fraction=self.config.shadow_fraction,
+            tolerance=self.config.shadow_tolerance,
+            min_samples=self.config.shadow_min_samples,
+        )
+        with self._lock:
+            self._armed = {
+                "mv": mv,
+                "gate": gate,
+                "t_armed": time.monotonic(),
+            }
+        telemetry.event(
+            "flywheel/candidate_armed",
+            version=mv.short,
+            fraction=self.config.shadow_fraction,
+        )
+        return {"state": "armed", "candidate": mv.short}
+
+    def _judge(self, armed: Dict[str, Any]) -> Dict[str, Any]:
+        mv: ModelVersion = armed["mv"]
+        report = armed["gate"].report()
+        elapsed = time.monotonic() - armed["t_armed"]
+        if report["failures"] > 0:
+            # Red: failures never reset — this gate can never go green.
+            return self._reject(mv, reason="gate_red", gate=report)
+        if report["green"] and elapsed >= self.config.gate_window_s:
+            return self._promote(mv, report)
+        if elapsed > self.config.gate_patience_s:
+            # Starved gate (drops/errors/no traffic): refusing is the safe
+            # default — an unjudged candidate must not linger armed forever.
+            return self._reject(mv, reason="gate_starved", gate=report)
+        return {"state": "armed", "candidate": mv.short, "gate": report}
+
+    def _promote(self, mv: ModelVersion, gate: Dict[str, Any]) -> Dict[str, Any]:
+        try:
+            result = self.manager.promote()
+        except (SwapGateError, CandidateVerificationError, LifecycleError) as e:
+            # promote() re-reads the live gate and re-verifies the load; a
+            # refusal here is a rejection with the manager's own evidence.
+            return self._reject(mv, reason=f"promote_refused: {e!r}", gate=gate)
+        with self._lock:
+            self._armed = None
+            self._counters["promotions"] += 1
+            self._last_promote = {
+                "version": result["version"],
+                "previous_version": result["previous_version"],
+                "gate": gate,
+            }
+        telemetry.counter("flywheel/promotions")
+        telemetry.event(
+            "flywheel/promoted",
+            version=result["version"],
+            previous_version=result["previous_version"],
+            compared=gate.get("compared"),
+            diff_max=gate.get("diff_max"),
+        )
+        return {"state": "promoted", "result": result}
+
+    def _reject(
+        self,
+        mv: ModelVersion,
+        reason: str,
+        gate: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Auto-rollback of the weights loop: disarm the shadow, quarantine
+        a copy of the candidate's bytes for forensics, drop the candidate
+        role, and dump the flight recorder under the ``flywheel_reject``
+        trigger. The live fleet never saw the candidate — refusing IS the
+        rollback; ``manager.rollback()`` stays an operator action for a
+        promotion regretted later."""
+        self.router.clear_shadow()
+        quarantined = self._quarantine(mv)
+        self.registry.clear_candidate(reason=reason)
+        dump = telemetry.flight_dump(
+            "flywheel_reject",
+            run_dir=self.run_dir,
+            extra={
+                "candidate": mv.short,
+                "reason": reason,
+                "gate": gate,
+                "quarantined": quarantined,
+            },
+        )
+        with self._lock:
+            self._armed = None
+            self._counters["rejections"] += 1
+            self._last_reject = {
+                "candidate": mv.short,
+                "reason": reason,
+                "gate": gate,
+                "quarantined": quarantined,
+                "flight_dump": dump,
+            }
+        telemetry.counter("flywheel/rejections")
+        telemetry.event(
+            "flywheel/rejected", version=mv.short, reason=reason
+        )
+        return {"state": "rejected", "candidate": mv.short, "reason": reason}
+
+    def _quarantine(self, mv: ModelVersion) -> Optional[str]:
+        """Copy the rejected candidate's bytes aside (best-effort: the
+        evidence should survive the trainer overwriting ``<name>.pk`` with
+        its next save, but a vanished file must not mask the rejection)."""
+        qdir = os.path.join(self.run_dir, self.config.quarantine_dir)
+        dst = os.path.join(qdir, f"{mv.short}.pk")
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            shutil.copyfile(mv.path, dst)
+        except OSError:
+            return None
+        return dst
+
+    # ------------------------------------------------------------- data loop
+    def _data_step(self) -> Dict[str, Any]:
+        fed = self._pull_histograms()
+        now = time.monotonic()
+        with self._lock:
+            due = now - self._last_drift_eval >= self.config.refit_interval_s
+            if due:
+                self._last_drift_eval = now
+        if not due:
+            return {"state": "sampling", "fed": fed}
+        verdict = self.detector.evaluate()
+        if verdict["transition"] == "entered":
+            return self._refit(verdict)
+        return {"state": "watching", "fed": fed, "drift": verdict}
+
+    def _pull_histograms(self) -> int:
+        """Feed the detector each engine's size-histogram DELTA since the
+        last tick (cumulative counts minus what was already seen)."""
+        total = 0
+        for engine in self.manager.engines:
+            metrics = getattr(engine, "metrics", None)
+            if metrics is None:
+                continue
+            doc = metrics.histogram_json()  # one locked copy, engine-side
+            current = {
+                (int(n), int(e)): int(w)
+                for n, e, w in doc.get("graph_sizes", ())
+            }
+            with self._lock:
+                seen = self._hist_seen.setdefault(id(engine), {})
+                delta = [
+                    (n, e, c - seen.get((n, e), 0))
+                    for (n, e), c in current.items()
+                    if c - seen.get((n, e), 0) > 0
+                ]
+                self._hist_seen[id(engine)] = current
+            total += self.detector.observe(delta)
+        return total
+
+    def _refit(self, verdict: Dict[str, Any]) -> Dict[str, Any]:
+        """Sustained drift → fit a new ladder to the window's traffic and
+        swap it across the fleet. Runs on the control thread — the warm
+        (compile/hydrate of new rungs) is background work relative to
+        serving; each engine's publish is one atomic reference rebind."""
+        window = self.detector.window_histogram()
+        new_ladder = fit_ladder(window, max_rungs=self.config.max_rungs)
+        with self._lock:
+            self._counters["ladder_refits"] += 1
+        telemetry.counter("flywheel/ladder_refits")
+        swaps: List[Dict[str, Any]] = []
+        for engine in self.manager.engines:
+            if not hasattr(engine, "swap_ladder"):
+                continue
+            swaps.append(engine.swap_ladder(new_ladder, warm=True))
+        if swaps:
+            with self._lock:
+                self._counters["ladder_swaps"] += len(swaps)
+            telemetry.counter("flywheel/ladder_swaps", len(swaps))
+        self.detector.rebase(window)
+        telemetry.event(
+            "flywheel/ladder_refit",
+            rungs=len(new_ladder),
+            distance=verdict.get("distance"),
+            engines=len(swaps),
+            compiled=sum(s["compiled"] for s in swaps),
+            hydrated=sum(s["hydrated"] for s in swaps),
+        )
+        return {
+            "state": "refit",
+            "ladder": [list(r) for r in new_ladder],
+            "swaps": swaps,
+            "drift": verdict,
+        }
+
+    # --------------------------------------------------------------- status
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            armed = self._armed
+            out: Dict[str, Any] = {
+                "attached": self._attached,
+                "running": self._thread is not None
+                and self._thread.is_alive(),
+                "armed": None
+                if armed is None
+                else {"candidate": armed["mv"].short},
+                "counters": dict(self._counters),
+                "last_promote": self._last_promote,
+                "last_reject": self._last_reject,
+            }
+        out["drift"] = self.detector.report()
+        return out

@@ -7,6 +7,8 @@ from .packing import (
     SizeHistogram,
     first_fit_decreasing,
     fit_ladder,
+    histogram_distance,
+    node_distribution,
     resolve_ladder_spec,
 )
 

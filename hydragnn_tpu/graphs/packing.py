@@ -365,6 +365,58 @@ def ladder_waste(
     return total_waste / total_w if total_w else 0.0
 
 
+# ------------------------------------------------------------- drift distance
+def node_distribution(
+    hist: "SizeHistogram | Sequence[Tuple[int, int, int]]",
+    mode: str = "mult64",
+    step: int = 64,
+    min_nodes: int = 8,
+) -> Dict[int, float]:
+    """Normalized node-size distribution over compiled-shape bins.
+
+    Each observed graph size is quantized to its :func:`round_up_step`
+    boundary (the same quantization the ladder fitter pads to), then the
+    per-bin weights are normalized to sum to 1. Quantizing BEFORE comparing
+    is what makes the drift detector's distance mean something operational:
+    two traffic mixes that land in the same compiled shapes are, for the
+    batcher, the same distribution — only mass moving across a shape
+    boundary can change occupancy or trigger fallback.
+    """
+    if isinstance(hist, SizeHistogram):
+        rows = [(n, e, w) for (n, e), w in sorted(hist.graphs.items())]
+        if not rows:
+            rows = [(n, e, w) for (n, e, g), w in sorted(hist.batches.items())]
+    else:
+        rows = [(int(n), int(e), int(w)) for n, e, w in hist]
+    rows = [(n, e, w) for n, e, w in rows if w > 0]
+    if not rows:
+        raise ValueError("cannot build a distribution from an empty histogram")
+    bins: Dict[int, float] = {}
+    total = 0.0
+    for n, _e, w in rows:
+        b = round_up_step(n, minimum=min_nodes, mode=mode, step=step)
+        bins[b] = bins.get(b, 0.0) + w
+        total += w
+    return {b: v / total for b, v in sorted(bins.items())}
+
+
+def histogram_distance(
+    p: "SizeHistogram | Sequence[Tuple[int, int, int]]",
+    q: "SizeHistogram | Sequence[Tuple[int, int, int]]",
+    mode: str = "mult64",
+    step: int = 64,
+    min_nodes: int = 8,
+) -> float:
+    """Total-variation distance in [0, 1] between two histograms'
+    :func:`node_distribution`\\ s — the flywheel drift detector's metric.
+    0 means the two traffic mixes occupy compiled shapes identically; 1
+    means disjoint support (every request would hit a different rung)."""
+    pd = node_distribution(p, mode=mode, step=step, min_nodes=min_nodes)
+    qd = node_distribution(q, mode=mode, step=step, min_nodes=min_nodes)
+    keys = set(pd) | set(qd)
+    return 0.5 * sum(abs(pd.get(k, 0.0) - qd.get(k, 0.0)) for k in keys)
+
+
 # ----------------------------------------------------------------- spec forms
 def parse_ladder_literal(spec: str) -> List[Tuple[int, int]]:
     """``"512x4096,1024x8192"`` → ``[(512, 4096), (1024, 8192)]``."""

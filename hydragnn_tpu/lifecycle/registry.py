@@ -287,6 +287,26 @@ class ModelRegistry:
         )
         return mv
 
+    def clear_candidate(self, reason: str = "") -> Optional[ModelVersion]:
+        """Drop the staged candidate role (one atomic sidecar install) and
+        return what was staged (None when nothing was). The flywheel's
+        rejection path: a red shadow gate clears the candidate so the next
+        checkpoint can stage cleanly — the live/previous roles are
+        untouched, and the candidate's BYTES stay wherever they were (the
+        flywheel quarantines a copy for forensics before calling this)."""
+        with self._lock:
+            doc = self._roles.get(ROLE_CANDIDATE)
+            self._roles[ROLE_CANDIDATE] = None
+        self._persist()
+        if not doc:
+            return None
+        telemetry.event(
+            "swap/candidate_cleared",
+            version=doc["version"][:12],
+            reason=reason or None,
+        )
+        return ModelVersion(**doc)
+
     # ------------------------------------------------------------------ loads
     def load_role(
         self, role: str, variables: Dict[str, Any]

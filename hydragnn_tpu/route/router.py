@@ -220,6 +220,13 @@ class Router:
         # rides a bounded self-sync queue so a slow candidate can never
         # block live traffic (full queue -> dropped, counted on the gate).
         self._shadow: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        # Most recent DISARMED gate record: clear_shadow() used to drop the
+        # gate entirely, which made the counters operators need to judge a
+        # verdict (mirrored vs dropped vs compared) vanish from /healthz and
+        # /metrics the instant the arm came down. Kept until the next
+        # set_shadow so a scrape between gate cycles still sees the last
+        # cycle's evidence.
+        self._last_shadow: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
         self._shadow_queue: "queue.Queue" = queue.Queue(maxsize=64)
         self._shadow_thread: Optional[threading.Thread] = None
         self._shadow_ctx: Optional[Any] = None
@@ -355,11 +362,19 @@ class Router:
         return gate
 
     def clear_shadow(self) -> None:
-        """Disarm shadow mode (the gate record stays readable via the
-        returned handle; promotion already consumed it)."""
+        """Disarm shadow mode. The gate record is RETAINED (``_last_shadow``)
+        so ``shadow_report``/``shadow_prometheus`` keep exposing the last
+        cycle's mirrored/dropped/compared evidence until the next arm —
+        promotion consumed the verdict, but operators auditing it have not."""
         with self._lock:
             shadow = self._shadow
             self._shadow = None
+            if shadow is not None:
+                self._last_shadow = {
+                    "replica_name": shadow["replica"].name,
+                    "fraction": shadow["fraction"],
+                    "gate": shadow["gate"],
+                }
         if shadow is not None:
             self.metrics.set_replica_state(shadow["replica"].name, None)
             telemetry.event(
@@ -372,8 +387,16 @@ class Router:
         and the router /healthz exposes."""
         with self._lock:
             shadow = self._shadow
+            last = self._last_shadow
         if shadow is None:
-            return {"configured": False, "green": False}
+            out: Dict[str, Any] = {"configured": False, "green": False}
+            if last is not None:
+                lg = last["gate"].report()
+                lg.update(
+                    replica=last["replica_name"], fraction=last["fraction"]
+                )
+                out["last_gate"] = lg
+            return out
         report = shadow["gate"].report()
         report.update(
             configured=True,
@@ -383,11 +406,16 @@ class Router:
         return report
 
     def shadow_prometheus(self) -> str:
-        """``hydragnn_swap_*`` exposition ('' when no shadow is armed) —
-        appended to the router /metrics payload."""
+        """``hydragnn_swap_*`` exposition — the armed gate's counters, or
+        the last disarmed gate's (so mirrored/dropped/compared totals do not
+        disappear from /metrics between gate cycles); '' only before the
+        first arm."""
         with self._lock:
             shadow = self._shadow
-        return shadow["gate"].render_prometheus() if shadow else ""
+            last = self._last_shadow
+        if shadow is not None:
+            return shadow["gate"].render_prometheus()
+        return last["gate"].render_prometheus() if last else ""
 
     def _start_shadow_worker(self) -> None:
         if self._shadow_thread is not None and self._shadow_thread.is_alive():

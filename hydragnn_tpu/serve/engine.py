@@ -353,7 +353,14 @@ class InferenceEngine:
         self._y_minmax = y_minmax
         self._g_pad = self.max_batch_graphs + 1
         self._edge_dim = model.edge_dim if model.use_edge_attr else 0
-        self._ladder = sorted(
+        # The bucket ladder is published like the weights: ONE sorted-list
+        # reference, rebound atomically under _lock by warmup()'s merge and
+        # swap_ladder() (the flywheel's drift-refit path). The batcher takes
+        # a single locked snapshot per flush and threads it through
+        # _pack_groups/_collate/_bucket_shape, so every batch — and
+        # therefore every request — is planned against exactly one ladder
+        # even while a swap lands mid-flush.
+        self._ladder = sorted(  # guarded-by: self._lock, dirty-reads(status surfaces read the immutable list reference for display; consistency-bearing readers snapshot under the lock via _current_ladder)
             (int(n), int(e)) for n, e in (bucket_ladder or ())
         )
         self._packing = bool(packing)
@@ -514,6 +521,14 @@ class InferenceEngine:
         gate, status surfaces) may observe the weights."""
         with self._lock:
             return self._weights
+
+    def _current_ladder(self) -> List[Tuple[int, int]]:
+        """One locked read of the published bucket-ladder reference (the
+        ladder analog of ``_current_weights``). The returned list is never
+        mutated in place — swaps rebind the reference — so callers may hold
+        the snapshot across a whole flush."""
+        with self._lock:
+            return self._ladder
 
     def variables_template(self) -> Dict[str, Any]:
         """THE variables template verified checkpoint loads restore onto
@@ -800,9 +815,13 @@ class InferenceEngine:
                     saw_shutdown = True
                     break
                 entries.append(nxt)
-            for group in self._pack_groups(entries):
+            # ONE ladder snapshot per flush: bin planning and bucket
+            # selection below must agree on the rung set, even if
+            # swap_ladder publishes a new ladder mid-flush.
+            ladder = self._current_ladder()
+            for group in self._pack_groups(entries, ladder):
                 try:
-                    work = self._collate(group)
+                    work = self._collate(group, ladder)
                 except Exception as e:  # noqa: BLE001
                     # A bad batch (collation failure past _validate's
                     # checks) fails ITS requests loudly but must not poison
@@ -822,14 +841,17 @@ class InferenceEngine:
             if saw_shutdown:
                 return
 
-    def _pack_groups(self, entries: List[_Request]) -> List[List[_Request]]:
+    def _pack_groups(
+        self, entries: List[_Request], ladder: List[Tuple[int, int]]
+    ) -> List[List[_Request]]:
         """Split one flush into arena-slot bins (first-fit-decreasing under
         the top ladder rung's capacity) when packing is on; otherwise the
         flush is one bin, the historical behavior. Every request of the
-        flush appears in exactly one bin (demux identity is per-bin)."""
-        if not (self._packing and self._ladder):
+        flush appears in exactly one bin (demux identity is per-bin).
+        ``ladder`` is the batcher's per-flush snapshot."""
+        if not (self._packing and ladder):
             return [entries]
-        top_n, top_e = self._ladder[-1]
+        top_n, top_e = ladder[-1]
         caps = PackCaps(
             nodes=top_n - 1, edges=top_e, graphs=self.max_batch_graphs
         )
@@ -840,20 +862,32 @@ class InferenceEngine:
         )
         return [[entries[i] for i in members] for members in bins]
 
-    def _bucket_shape(self, tot_nodes: int, tot_edges: int) -> Tuple[int, int, bool]:
+    def _bucket_shape(
+        self,
+        tot_nodes: int,
+        tot_edges: int,
+        ladder: Optional[List[Tuple[int, int]]] = None,
+    ) -> Tuple[int, int, bool]:
         """Smallest ladder (N_pad, E_pad) the batch fits, else round-up
         fallback (``ladder_step`` mode). collate requires N_pad > tot_nodes
-        (>=1 padding node) and E_pad >= tot_edges."""
-        for n, e in self._ladder:
+        (>=1 padding node) and E_pad >= tot_edges. The batcher passes its
+        per-flush ladder snapshot; other callers default to a fresh one."""
+        if ladder is None:
+            ladder = self._current_ladder()
+        for n, e in ladder:
             if n > tot_nodes and e >= tot_edges:
                 return n, e, False
         return (
             round_up_pow2(tot_nodes + 1, mode=self._ladder_step),
             round_up_pow2(max(tot_edges, 1), mode=self._ladder_step),
-            bool(self._ladder),
+            bool(ladder),
         )
 
-    def _collate(self, entries: List[_Request]) -> _BatchWork:
+    def _collate(
+        self,
+        entries: List[_Request],
+        ladder: Optional[List[Tuple[int, int]]] = None,
+    ) -> _BatchWork:
         t0 = time.perf_counter()
         # Queue wait ends at the FLUSH (now), before collation starts — the
         # stage decomposition must not double-count collate seconds.
@@ -868,7 +902,9 @@ class InferenceEngine:
             arena = GraphArena(samples)
             tot_nodes = int(arena.ns.sum())
             tot_edges = int(arena.es.sum())
-            n_pad, e_pad, fallback = self._bucket_shape(tot_nodes, tot_edges)
+            n_pad, e_pad, fallback = self._bucket_shape(
+                tot_nodes, tot_edges, ladder
+            )
             batch = arena.collate(
                 np.arange(len(samples)),
                 num_nodes_pad=n_pad,
@@ -1195,9 +1231,10 @@ class InferenceEngine:
         _bucket_shape can never select would be wasted compile time.
         Returns the number of executables compiled."""
         if ladder:
-            self._ladder = sorted(
-                set(self._ladder) | {(int(n), int(e)) for n, e in ladder}
-            )
+            with self._lock:
+                self._ladder = sorted(
+                    set(self._ladder) | {(int(n), int(e)) for n, e in ladder}
+                )
         compiled = 0
         params, bstats, _version = self._current_weights()
         # Iterate the MERGED ladder: constructor-declared buckets still cold
@@ -1205,7 +1242,7 @@ class InferenceEngine:
         # persistent store bound, a rung found on disk HYDRATES (seconds,
         # zero XLA compiles — the replica-spin-up path docs/COMPILE_CACHE.md
         # exists for) and does not count toward the compile total.
-        for n_pad, e_pad in self._ladder:
+        for n_pad, e_pad in self._current_ladder():
             key = (int(n_pad), int(e_pad), self._g_pad)
             if self._registry.get(key) is not None:
                 continue
@@ -1240,6 +1277,89 @@ class InferenceEngine:
             num_graphs_pad=self._g_pad,
             edge_dim=self._edge_dim,
         )
+
+    # ------------------------------------------------------ hot ladder swap
+    def swap_ladder(
+        self, ladder: Sequence[Tuple[int, int]], warm: bool = True
+    ) -> Dict[str, Any]:
+        """Atomic, per-request-consistent hot bucket-ladder swap — the data
+        loop's analog of :meth:`swap_weights` (flywheel drift-refit,
+        docs/FLYWHEEL.md).
+
+        ``warm=True`` (the default, and what the flywheel uses) compiles or
+        hydrates every rung of the NEW ladder through the shared executable
+        registry BEFORE publishing, on the calling thread — so the batcher
+        never selects a cold rung and rungs the old ladder already compiled
+        (or a previous process persisted to the graftcache store) publish
+        with ZERO XLA compiles. The publish itself rebinds the single sorted
+        ladder reference under the engine lock; the batcher snapshots that
+        reference once per flush, so every request is planned entirely
+        against one ladder — no torn flush, no dropped request.
+
+        Old-ladder executables stay in the registry (memory + store): a
+        rollback swap re-publishes them without compiling, and oversized
+        in-flight traffic still resolves through the pow2 fallback.
+
+        Returns {ladder, previous, compiled, hydrated, wall_s}.
+        """
+        new = sorted({(int(n), int(e)) for n, e in ladder})
+        if not new:
+            raise ValueError(
+                "swap_ladder needs at least one (N_pad, E_pad) rung"
+            )
+        if self._error is not None:
+            raise EngineFailedError(
+                "inference worker died; engine must be rebuilt"
+            ) from self._error
+        if self._closing.is_set():
+            raise EngineClosedError("engine is shut down")
+        t0 = time.perf_counter()
+        compiled = hydrated = 0
+        # Same whole-swap mutex as weight swaps: a ladder swap racing a
+        # weight swap must warm against a settled weight reference, and two
+        # ladder swaps must publish in a total order.
+        with self._swap_lock:
+            if warm:
+                params, bstats, _version = self._current_weights()
+                for n_pad, e_pad in new:
+                    key = (n_pad, e_pad, self._g_pad)
+                    if self._registry.get(key) is not None:
+                        continue
+                    batch = self._dummy_batch(n_pad, e_pad)
+                    _exe, outcome, seconds = self._registry.lookup_or_compile(
+                        key,
+                        self._cache_key(key, batch, params, bstats),
+                        lambda b=batch: self._jit.lower(params, bstats, b),
+                    )
+                    if outcome == "disk":
+                        self.metrics.record_hydrate(seconds)
+                        hydrated += 1
+                    elif outcome == "compiled":
+                        self.metrics.record_compile(seconds)
+                        compiled += 1
+            # Annotated interleaving site: the publish races the batcher's
+            # per-flush snapshot — the tsan flywheel drill perturbs exactly
+            # this window (benchmarks/tsan_drill.py _flywheel_drill).
+            tsan.yield_point("serve.ladder.pre_publish")
+            with self._lock:
+                previous = self._ladder
+                self._ladder = new
+        wall = time.perf_counter() - t0
+        self.metrics.count("ladder_swaps_total")
+        telemetry.event(
+            "serve/ladder_swapped",
+            rungs=len(new),
+            compiled=compiled,
+            hydrated=hydrated,
+            wall_s=round(wall, 4),
+        )
+        return {
+            "ladder": [list(r) for r in new],
+            "previous": [list(r) for r in previous],
+            "compiled": compiled,
+            "hydrated": hydrated,
+            "wall_s": round(wall, 4),
+        }
 
     # ------------------------------------------------------ hot weight swap
     def swap_weights(self, variables: Dict[str, Any], version: str) -> Dict[str, Any]:

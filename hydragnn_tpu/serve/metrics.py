@@ -170,6 +170,9 @@ class ServeMetrics:
         self.weight_swaps_total = 0  # guarded-by: self._lock
         self.swap_rejected_total = 0  # guarded-by: self._lock
         self.swap_gate_failures_total = 0  # guarded-by: self._lock
+        # Completed hot bucket-ladder swaps (the flywheel's drift-refit
+        # path, serve/engine.py swap_ladder — docs/FLYWHEEL.md).
+        self.ladder_swaps_total = 0  # guarded-by: self._lock
         self.batches_total = 0  # guarded-by: self._lock
         self.graphs_total = 0  # guarded-by: self._lock
         self.cache_hits_total = 0  # guarded-by: self._lock
@@ -304,6 +307,7 @@ class ServeMetrics:
                 "weight_swaps_total": self.weight_swaps_total,
                 "swap_rejected_total": self.swap_rejected_total,
                 "swap_gate_failures_total": self.swap_gate_failures_total,
+                "ladder_swaps_total": self.ladder_swaps_total,
                 "batches_total": batches,
                 "graphs_total": self.graphs_total,
                 "bucket_cache": {
@@ -384,6 +388,7 @@ class ServeMetrics:
         ("weight_swaps_total", "weight_swaps_total"),
         ("swap_rejected_total", "swap_rejected_total"),
         ("swap_gate_failures_total", "swap_gate_failures_total"),
+        ("ladder_swaps_total", "ladder_swaps_total"),
         ("batches_total", "batches_total"),
         ("graphs_total", "graphs_total"),
         ("cache_hits_total", "bucket_cache_hits_total"),

@@ -509,3 +509,66 @@ def pytest_committed_stream_artifact_readable():
     assert blk["params_bit_exact"] is True
     assert blk["streamed_over_inmemory_wall"] is not None
     assert blk["drills_passed"] == blk["drills_total"] == 2
+
+
+def pytest_last_known_flywheel_picks_latest_real_measurement(tmp_path):
+    from bench import _last_known_flywheel
+
+    real = {
+        "drills_total": 2,
+        "drills_passed": 2,
+        "soak": {
+            "counters": {"promotions": 2, "rejections": 1},
+            "poisoned_never_served": True,
+            "recompiles_after_warmup": 0,
+            "lost_total": 0,
+            "zero_version_torn": True,
+        },
+        "platform": "cpu",
+        "device_kind": "cpu",
+    }
+    (tmp_path / "FLYWHEEL_r17.json").write_text(json.dumps(real))
+    # A failed --flywheel round carries no soak block — never "last known".
+    (tmp_path / "FLYWHEEL_r18.json").write_text(
+        json.dumps({"error": "TimeoutError"})
+    )
+    now = time.time()
+    os.utime(tmp_path / "FLYWHEEL_r17.json", (now - 50, now - 50))
+    os.utime(tmp_path / "FLYWHEEL_r18.json", (now - 10, now - 10))
+
+    blk = _last_known_flywheel(str(tmp_path))
+    assert blk is not None
+    assert blk["promotions"] == 2
+    assert blk["rejections"] == 1
+    assert blk["poisoned_never_served"] is True
+    assert blk["recompiles_after_warmup"] == 0
+    assert blk["lost_total"] == 0
+    assert blk["provenance"] == "stale"
+    assert blk["source_artifact"] == "FLYWHEEL_r17.json"
+
+
+def pytest_last_known_flywheel_none_when_no_measurements(tmp_path):
+    from bench import _last_known_flywheel
+
+    (tmp_path / "FLYWHEEL_bad.json").write_text("{not json")
+    (tmp_path / "FLYWHEEL_r09.json").write_text(json.dumps({"error": "boom"}))
+    assert _last_known_flywheel(str(tmp_path)) is None
+
+
+def pytest_committed_flywheel_artifact_readable():
+    """The committed FLYWHEEL_r* round is a valid last-known block with the
+    acceptance gates green: >=2 auto-promotions, the poisoned candidate
+    refused without serving, zero lost accepted requests, zero torn
+    versions, zero recompiles after warm-up."""
+    from bench import _last_known_flywheel
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    blk = _last_known_flywheel(repo)
+    assert blk is not None
+    assert blk["drills_passed"] == blk["drills_total"]
+    assert blk["promotions"] >= 2
+    assert blk["rejections"] == 1
+    assert blk["poisoned_never_served"] is True
+    assert blk["recompiles_after_warmup"] == 0
+    assert blk["lost_total"] == 0
+    assert blk["zero_version_torn"] is True

@@ -1,0 +1,390 @@
+"""graftloop (hydragnn_tpu/flywheel/) — the continuous-learning flywheel.
+
+Covers the ISSUE-18 contract: the post-save observer staging candidates off
+ASYNC checkpoint saves, the shadow gate auto-promoting a genuine fine-tune
+and refusing a FaultPlan-poisoned one (quarantine + flight dump, live
+untouched), drift-detector hysteresis that cannot flap on boundary noise,
+the atomic warm ladder swap (request-consistent, zero recompiles for
+previously-seen rungs), retention GC never collecting a role-pinned
+checkpoint (the keep_last_k bugfix regression), shadow observability
+surviving disarm, bad-flywheel config findings, and (slow) the supervisor
+kill-during-promotion resume drill. Tier-1 except the kill drill, CPU.
+"""
+
+import glob
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.flywheel_soak import fine_tune
+from benchmarks.serve_load import (
+    _host_variables as _host_vars,
+    _perturb,
+    _swap_fixture,
+    build_serving_engine,
+)
+from hydragnn_tpu.analysis.sentinel import compile_count
+from hydragnn_tpu.checkpoint.async_writer import AsyncCheckpointer
+from hydragnn_tpu.checkpoint.io import role_pinned_files, save_model
+from hydragnn_tpu.flywheel import DriftDetector, Flywheel, FlywheelConfig
+from hydragnn_tpu.graphs import histogram_distance
+from hydragnn_tpu.lifecycle import LifecycleManager, ModelRegistry
+from hydragnn_tpu.route import InProcessReplica, Router
+
+# Small fast engines where the contract is size-independent; the promote/
+# reject test uses the bench-family defaults because the genuine and the
+# poisoned fine-tunes (benchmarks/flywheel_soak.fine_tune) train that model.
+SMALL = dict(
+    hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0, pool_size=8
+)
+
+
+def _flywheel_rig(tmp, fixture_kw=None, **cfg_kw):
+    """Fixture + router + shadow engine + attached (not started) flywheel.
+    Tests drive tick() directly — deterministic, no timer thread."""
+    registry, engines, graphs, run_dir, vars0 = _swap_fixture(
+        tmp, n_replicas=1, **(fixture_kw or {})
+    )
+    engine = engines[0]
+    shadow, _ = build_serving_engine(
+        model_version="shadow", **(fixture_kw or {})
+    )
+    router = Router(
+        [InProcessReplica("fly-test", engine)],
+        health_interval_s=0.1,
+        jitter_seed=0,
+    )
+    manager = LifecycleManager(registry, [engine], router=router)
+    cfg = dict(
+        shadow_fraction=1.0,
+        shadow_tolerance=0.5,
+        shadow_min_samples=2,
+        gate_window_s=0.0,
+        gate_patience_s=60.0,
+        refit_interval_s=3600.0,
+    )
+    cfg.update(cfg_kw)
+    fly = Flywheel(
+        registry,
+        manager,
+        router,
+        shadow,
+        [(g.num_nodes, g.num_edges, 1) for g in graphs],
+        config=FlywheelConfig(**cfg),
+        run_dir=run_dir,
+    ).attach()
+
+    def close():
+        fly.stop()
+        router.close()
+        engine.close()
+        shadow.close()
+
+    return registry, engine, graphs, run_dir, vars0, router, manager, fly, close
+
+
+def _drive(fly, router, graphs, want_state, rounds=128):
+    state = None
+    for i in range(rounds):
+        router.predict([graphs[i % len(graphs)]], request_id=f"t-{i}")
+        state = fly.tick()["weights"].get("state")
+        if state == want_state:
+            return state
+    return state
+
+
+# ------------------------------------------- 1. staging hook on async saves
+def pytest_staging_hook_fires_on_async_saves(tmp_path):
+    """The flywheel's post-save observer must see checkpoints written by the
+    ASYNC writer thread (AsyncCheckpointer funnels into the same
+    ckpt_io.save_model hook site), stage them as registry candidates, and
+    arm the shadow — no polling, no trainer-side wiring."""
+    tmp = str(tmp_path)
+    (registry, engine, graphs, run_dir, vars0, router, manager, fly, close
+     ) = _flywheel_rig(tmp, fixture_kw=SMALL)
+    try:
+        assert registry.candidate is None
+        ac = AsyncCheckpointer()
+        try:
+            ac.save(
+                _perturb(vars0, 1e-3, seed=3), None, name=registry.name,
+                path=tmp, meta={"epoch": 1}, keep_last_k=3,
+            )
+            ac.wait()
+        finally:
+            ac.close()
+        out = fly.tick()["weights"]
+        assert out["state"] == "armed", out
+        assert registry.candidate is not None
+        rep = fly.report()
+        assert rep["counters"]["checkpoints_observed"] == 1
+        assert rep["counters"]["candidates_staged"] == 1
+        # The router really is mirroring: the shadow arm is configured.
+        assert router.shadow_report()["configured"] is True
+    finally:
+        close()
+
+
+# ------------------------------- 2. green gate promotes, red gate rejects
+def pytest_green_gate_promotes_and_poisoned_candidate_rejected(tmp_path):
+    """The two verdicts end to end: a GENUINE fine-tune (real optimizer
+    steps on clean labels) goes green and is auto-promoted; a
+    FaultPlan-poisoned fine-tune of the same recipe blows the tolerance
+    gate, is refused, quarantined, and dumped — and the live version never
+    moves off the promoted genuine one."""
+    tmp = str(tmp_path)
+    (registry, engine, graphs, run_dir, vars0, router, manager, fly, close
+     ) = _flywheel_rig(tmp)
+    try:
+        initial = registry.live.short
+        save_model(
+            fine_tune(vars0, steps=2, lr=1e-4, seed=11), None,
+            registry.name, path=tmp, meta={"epoch": 1}, keep_last_k=3,
+        )
+        assert _drive(fly, router, graphs, "promoted") == "promoted"
+        promoted = registry.live.short
+        assert promoted != initial
+        assert fly.report()["counters"]["promotions"] == 1
+
+        save_model(
+            fine_tune(
+                vars0, steps=8, lr=0.05, seed=11,
+                poison_spec="poison_labels:frac=1.0:scale=20,seed=5",
+            ),
+            None, registry.name, path=tmp, meta={"epoch": 2}, keep_last_k=3,
+        )
+        assert _drive(fly, router, graphs, "rejected") == "rejected"
+        rep = fly.report()
+        assert rep["counters"]["rejections"] == 1
+        assert rep["last_reject"]["reason"] == "gate_red"
+        # Live never moved; the candidate role is cleared.
+        assert registry.live.short == promoted
+        assert registry.candidate is None
+        # Quarantine + flight-recorder evidence on disk.
+        assert glob.glob(os.path.join(run_dir, "quarantine", "*"))
+        assert glob.glob(
+            os.path.join(run_dir, "flightrec_*_flywheel_reject.json")
+        )
+    finally:
+        close()
+
+
+# ---------------------------------------------- 3. drift hysteresis no-flap
+def pytest_drift_hysteresis_does_not_flap_on_boundary_noise():
+    """Boundary noise — distances oscillating across the HIGH threshold
+    without ``sustain`` consecutive hits — must never enter drift; the
+    dead band between LOW and HIGH must hold whatever state the machine is
+    in; only a sustained excursion enters and only sub-LOW exits."""
+    source = [(16, 32, 10)]  # one mult64 bin
+    moved = (100, 200)  # lands in the next bin — mass that crosses a shape
+
+    def block(frac):
+        return [(16, 32, int((1 - frac) * 100)), (*moved, int(frac * 100))]
+
+    # Sanity-pin the distance semantics the thresholds below rely on.
+    assert histogram_distance(source, block(0.4)) >= 0.35
+    assert histogram_distance(source, block(0.2)) < 0.35
+    det = DriftDetector(source, high=0.35, low=0.15, window=1, sustain=3)
+
+    # Alternating over/under HIGH: the sustain counter resets every dip.
+    for _ in range(4):
+        det.observe(block(0.4))
+        assert det.evaluate()["transition"] is None
+        det.observe(block(0.2))
+        assert det.evaluate()["transition"] is None
+    assert not det.drifted and det.report()["enters_total"] == 0
+
+    # Sustained excursion: entered exactly once, on the 3rd consecutive hit.
+    outs = []
+    for _ in range(3):
+        det.observe(block(0.6))
+        outs.append(det.evaluate()["transition"])
+    assert outs == [None, None, "entered"] and det.drifted
+
+    # The dead band holds the drifted state (no exit, no re-enter).
+    det.observe(block(0.25))
+    assert det.evaluate()["transition"] is None and det.drifted
+
+    # Sub-LOW exits; rebase resets the machine onto the new source.
+    det.observe(block(0.05))
+    assert det.evaluate()["transition"] == "exited" and not det.drifted
+    det.observe(block(0.6))
+    det.rebase(block(0.6))
+    assert not det.drifted and det.report()["window_blocks"] == 0
+
+
+# --------------------------- 4. warm ladder swap: consistent, zero compiles
+def pytest_ladder_swap_request_consistent_zero_recompiles_for_warm_rungs(
+    tmp_path,
+):
+    """swap_ladder(warm=True) publishes only after every rung of the new
+    ladder is compiled: requests in flight across the swap all complete,
+    and traffic after the swap takes ZERO new XLA compiles when the rungs
+    were previously seen (the graftcache/registry hydration contract the
+    soak's ``recompiles_after_warmup=0`` gate measures at scale)."""
+    ladder0 = [(32, 128), (64, 256)]
+    engine, graphs = build_serving_engine(
+        bucket_ladder=ladder0, packing=True, **SMALL
+    )
+    try:
+        engine.predict(graphs[:4])  # populate the executable registry
+        grown = ladder0 + [(128, 512)]
+        futures = [engine.submit(g) for g in graphs]
+        t = threading.Thread(
+            target=lambda: engine.swap_ladder(grown, warm=True), daemon=True
+        )
+        t.start()
+        for f in futures:
+            np.asarray(f.result(timeout=120)[0])
+        t.join(120)
+        assert engine._current_ladder() == sorted(grown)
+        # Every post-swap request plans against warm rungs: no compiles.
+        c0 = compile_count()
+        for g in graphs:
+            engine.predict([g])
+        assert compile_count() == c0
+        # Swapping BACK re-publishes retained executables — also free.
+        engine.swap_ladder(ladder0, warm=True)
+        engine.predict(graphs[:4])
+        assert compile_count() == c0
+        assert engine.metrics.snapshot()["ladder_swaps_total"] == 2
+    finally:
+        engine.close()
+
+
+# ------------------------------- 5. retention GC never collects role pins
+def pytest_keep_last_k_never_collects_role_pinned_checkpoint(tmp_path):
+    """The ISSUE-18 retention bugfix: a checkpoint holding a ModelRegistry
+    role (live/candidate/previous) is a promotion/rollback target and must
+    survive keep_last_k GC no matter how many saves land after it; unpinned
+    files outside the window are still pruned."""
+    tmp = str(tmp_path)
+    name = "pinret"
+    tree = {"params": {"w": np.arange(4, dtype=np.float32)}}
+    save_model(tree, None, name, path=tmp, meta={"epoch": 0}, keep_last_k=2)
+    run_dir = os.path.join(tmp, name)
+    registry = ModelRegistry(run_dir, name)
+    live = registry.set_live()  # pins the epoch-0 file via the sidecar
+    pinned_file = os.path.basename(live.path)
+    assert pinned_file in role_pinned_files(run_dir, name)
+
+    for epoch in range(1, 6):
+        save_model(
+            {"params": {"w": np.arange(4, dtype=np.float32) + epoch}},
+            None, name, path=tmp, meta={"epoch": epoch}, keep_last_k=2,
+        )
+    # The pinned epoch-0 file survived five saves at k=2 …
+    assert os.path.exists(os.path.join(run_dir, pinned_file))
+    assert registry.live.short == live.short
+    # … while an unpinned file outside the window was pruned.
+    assert not os.path.exists(
+        os.path.join(run_dir, f"{name}.e000001.pk")
+    )
+    # And with the role released, the next save finally collects it.
+    registry.set_live()  # re-pin onto the newest checkpoint
+    save_model(
+        {"params": {"w": np.arange(4, dtype=np.float32) + 9}},
+        None, name, path=tmp, meta={"epoch": 6}, keep_last_k=2,
+    )
+    assert not os.path.exists(os.path.join(run_dir, pinned_file))
+
+
+# --------------------------------- 6. shadow observability survives disarm
+def pytest_shadow_counters_survive_disarm_on_report_and_prometheus(tmp_path):
+    """Satellite contract: mirrored/dropped/compared counts and the gate's
+    diff bound stay on /healthz (shadow_report) and the
+    ``hydragnn_swap_shadow_*`` exposition AFTER clear_shadow — promotion
+    consumed the verdict, operators auditing it have not."""
+    engine, graphs = build_serving_engine(model_version="live", **SMALL)
+    shadow_engine, _ = build_serving_engine(model_version="shadow", **SMALL)
+    router = Router(
+        [InProcessReplica("obs", engine)], health_interval_s=0.1,
+        jitter_seed=0,
+    )
+    try:
+        shadow_replica = InProcessReplica("obs-shadow", shadow_engine)
+        router.set_shadow(
+            shadow_replica, fraction=1.0, tolerance=0.5, min_samples=2
+        )
+        for i in range(6):
+            router.predict([graphs[i % len(graphs)]], request_id=f"o-{i}")
+        import time
+
+        for _ in range(200):  # mirror worker is async — wait for the quota
+            if router.shadow_report().get("compared", 0) >= 2:
+                break
+            time.sleep(0.02)
+        assert router.shadow_report().get("compared", 0) >= 2
+        armed = router.shadow_report()
+        router.clear_shadow()
+
+        rep = router.shadow_report()
+        assert rep["configured"] is False
+        last = rep["last_gate"]
+        assert last["mirrored"] == armed["mirrored"]
+        assert last["compared"] >= 2
+        assert last["tolerance"] == 0.5
+        assert "dropped" in last and "diff_max" in last
+        prom = router.shadow_prometheus()
+        assert "hydragnn_swap_shadow_mirrored_total" in prom
+        assert "hydragnn_swap_shadow_compared_total" in prom
+        assert "hydragnn_swap_shadow_dropped_total" in prom
+        assert "hydragnn_swap_shadow_tolerance_bound 0.5" in prom
+    finally:
+        router.close()
+        engine.close()
+        shadow_engine.close()
+
+
+# ----------------------------------------------- 7. bad-flywheel findings
+def pytest_bad_flywheel_config_findings():
+    from hydragnn_tpu.analysis.contracts import check_config
+
+    bad = {
+        "auto_promote": True,
+        "shadow_tolerance": 0.0,
+        "drift_high": 1.5,
+        "drift_low": 0.4,
+        "gate_window_s": 5.0,
+        "refit_interval_s": 1.0,
+        "keep_last_k": 2,
+        "checkpoint_async": False,
+    }
+    rep = check_config({}, strict=False, flywheel=bad)
+    msgs = [e["message"] for e in rep["errors"]
+            if e["code"] == "bad-flywheel"]
+    assert len(msgs) == 5, msgs
+    joined = "\n".join(msgs)
+    for needle in ("tolerance", "drift", "refit", "keep_last_k",
+                   "checkpoint_async"):
+        assert needle in joined, (needle, joined)
+
+    good = {
+        "auto_promote": True,
+        "shadow_tolerance": 1e-4,
+        "drift_high": 0.35,
+        "drift_low": 0.15,
+        "gate_window_s": 1.0,
+        "refit_interval_s": 5.0,
+        "keep_last_k": 3,
+        "checkpoint_async": True,
+    }
+    rep = check_config({}, strict=False, flywheel=good)
+    assert not [e for e in rep["errors"] if e["code"] == "bad-flywheel"]
+
+
+# ---------------------------------- 8. kill during promotion (slow, e2e)
+@pytest.mark.slow
+def pytest_kill_during_promotion_resumes_untorn():
+    from benchmarks.flywheel_soak import kill_during_promotion_drill
+
+    result = kill_during_promotion_drill()
+    assert result["killed_mid_promotion"], result
+    assert result["state_consistent_after_kill"], result
+    assert result["resumed"], result
+    assert result["promoted_after_restart"], result

@@ -221,11 +221,11 @@ def pytest_collation_failure_fails_batch_not_engine():
     real_collate = engine._collate
     calls = {"n": 0}
 
-    def flaky(entries):
+    def flaky(entries, ladder=None):
         calls["n"] += 1
         if calls["n"] == 1:
             raise ValueError("injected collation failure")
-        return real_collate(entries)
+        return real_collate(entries, ladder)
 
     engine._collate = flaky
     try:

@@ -458,11 +458,11 @@ def pytest_serve_429_echoes_request_id_and_healthz_logs_degraded():
         real_collate = engine2._collate
         calls = {"n": 0}
 
-        def flaky(entries):
+        def flaky(entries, ladder=None):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise ValueError("injected collation failure")
-            return real_collate(entries)
+            return real_collate(entries, ladder)
 
         engine2._collate = flaky
         fut = engine2.submit(graphs2[0], request_id="r-degrader")
