@@ -1670,10 +1670,12 @@ def faults_main() -> int:
 
 def analyze_main() -> int:
     """``python bench.py --analyze``: the round's static-health line
-    (ANALYSIS_rNN.json) — graftlint + graftrace rule hit counts +
-    suppression count over the package, the thread-root/lock-graph summary,
-    the seeded tsan drill outcome over the serve + async-checkpoint paths,
-    and check-config wall time over the committed CI configs — so the
+    (ANALYSIS_rNN.json) — graftlint + graftrace + graftproto rule hit
+    counts + the reasoned-suppression audit over the package, the
+    thread-root/lock-graph summary, the lockstep-segment/persistence-point
+    census with the full crash-consistency model-check verdict, the seeded
+    tsan drill outcome over the serve + async-checkpoint paths, and
+    check-config wall time over the committed CI configs — so the
     trajectory artifacts track static health alongside perf. CPU-safe and
     hardware-free by construction."""
     result = {
@@ -1765,6 +1767,63 @@ def analyze_main() -> int:
             **({"error": drill["error"]} if "error" in drill else {}),
         }
 
+        # The distributed-control-plane pass (graftproto) + its runtime
+        # half: the FULL crash-consistency sweep (every scenario, every
+        # auto-discovered persistence point, kill + exception per visit) —
+        # the drill above only ran the CI smoke subset.
+        t3 = time.perf_counter()
+        from hydragnn_tpu.analysis import model_check, proto_paths
+        from hydragnn_tpu.analysis.graftlint import Linter, Report
+        from hydragnn_tpu.analysis.rules import PROTO_RULES
+
+        proto = proto_paths(
+            [os.path.join(repo, "hydragnn_tpu")],
+            root=repo,
+            check_suppressions=False,
+        )
+        proto_fresh = new_violations(proto, load_baseline())
+        t4 = time.perf_counter()
+        verdict = model_check(seed=0)
+        audit_linter = Linter(
+            [os.path.join(repo, "hydragnn_tpu")], root=repo
+        )
+        audit_linter.load(Report())
+        audit = [
+            {"file": m.relpath, "line": line, "rule": rule,
+             "reason": reason or None}
+            for m in audit_linter.modules
+            for line, (rule, reason) in sorted(m.suppressions.items())
+        ]
+        result["graftproto"] = {
+            "proto_s": round(t4 - t3, 3),
+            "rule_counts": {
+                rule: n
+                for rule, n in proto.counts().items()
+                if rule in PROTO_RULES
+            },
+            "new_vs_baseline": len(proto_fresh),
+            "lockstep_segments": sorted(proto.lockstep_segments),
+            "persistence_points": len(proto.persistence_points),
+            "collective_functions": len(proto.collective_functions),
+            "modelcheck_s": round(time.perf_counter() - t4, 3),
+            "modelcheck": {
+                "ok": verdict["ok"],
+                "seed": verdict["seed"],
+                "num_points": verdict["num_points"],
+                "num_injections": verdict["num_injections"],
+                "points": verdict["points"],
+                "novel_points": verdict["novel_points"],
+                "known_drilled": verdict["known_drilled"],
+                "failures": verdict["failures"],
+                "schedule_sha256": verdict["schedule_sha256"],
+            },
+            "suppression_audit": {
+                "count": len(audit),
+                "reasonless": [a for a in audit if not a["reason"]],
+            },
+        }
+        result["value"] += float(len(proto.violations))
+
         from hydragnn_tpu.analysis import check_config
 
         cc = {}
@@ -1798,6 +1857,9 @@ def analyze_main() -> int:
         and configs_ok
         and result["tsan_drill"]["ok"]
         and not result["graftrace"]["lock_cycles"]
+        and result["graftproto"]["new_vs_baseline"] == 0
+        and result["graftproto"]["modelcheck"]["ok"]
+        and not result["graftproto"]["suppression_audit"]["reasonless"]
     )
     return 0 if ok else 1
 

@@ -12,7 +12,10 @@ anecdote.
 The drill then cross-checks what actually happened against the STATIC
 lock-order graph (``python -m hydragnn_tpu.analysis trace``): a dynamic
 acquisition order the static model missed, a dynamic inversion, or an
-unregistered cross-thread access all fail the run (exit 1).
+unregistered cross-thread access all fail the run (exit 1). Since graftproto
+(ISSUE 19) the drill also runs the SPMD/barrier lockstep pass and the
+crash-consistency model checker's smoke sweep — a proto violation or a
+recovery-invariant failure fails the run the same way.
 
     HYDRAGNN_TSAN is forced on BEFORE any hydragnn import, so module-level
     locks created at import time (graftel._lock — the registry behind
@@ -60,7 +63,12 @@ sys.path.insert(0, REPO)
 
 import numpy as np  # noqa: E402
 
-from hydragnn_tpu.analysis import tsan, trace_paths  # noqa: E402
+from hydragnn_tpu.analysis import (  # noqa: E402
+    model_check,
+    proto_paths,
+    trace_paths,
+    tsan,
+)
 
 # Yield sites whose visit counts are workload-determined (not race-
 # determined), so their recorded decision streams must be bit-identical
@@ -531,6 +539,26 @@ def _flywheel_drill(tmpdir: str) -> None:
         shadow.close()
 
 
+def _proto_drill(seed: int) -> dict:
+    """graftproto path (ISSUE 19): the static SPMD/barrier lockstep pass
+    over the package plus the crash-consistency SMOKE sweep (elastic shrink
+    + swap promote — the CI subset; the full scenario matrix runs in
+    tests/test_proto_lint.py). The checker's seeded schedule digest joins
+    the drill's determinism witness: same seed, same injection order."""
+    proto = proto_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
+    verdict = model_check(seed=seed, smoke=True)
+    return {
+        "static_violations": len(proto.violations),
+        "lockstep_segments": sorted(proto.lockstep_segments),
+        "persistence_points": len(proto.persistence_points),
+        "modelcheck_ok": verdict["ok"],
+        "modelcheck_points": verdict["num_points"],
+        "modelcheck_injections": verdict["num_injections"],
+        "modelcheck_failures": verdict["failures"],
+        "modelcheck_schedule_sha256": verdict["schedule_sha256"],
+    }
+
+
 def run_drill(seed: int) -> dict:
     tsan.enable(seed=seed)
     tsan.reset()
@@ -548,6 +576,7 @@ def run_drill(seed: int) -> dict:
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)
+    proto = _proto_drill(seed)
     det = {s: tsan.schedule(s) for s in _DETERMINISTIC_SITES}
     digest = hashlib.sha256(
         json.dumps(det, sort_keys=True).encode()
@@ -558,6 +587,8 @@ def run_drill(seed: int) -> dict:
         and not rep["unregistered_cross_thread"]
         and not static.lock_cycles
         and not static.violations
+        and proto["static_violations"] == 0
+        and proto["modelcheck_ok"]
     )
     return {
         "seed": seed,
@@ -573,6 +604,7 @@ def run_drill(seed: int) -> dict:
         "yield_counts": rep["yield_counts"],
         "deterministic_sites": det,
         "schedule_sha256": digest,
+        "proto": proto,
     }
 
 
@@ -595,6 +627,8 @@ def main(argv=None) -> int:
             f"{len(result['unregistered_cross_thread'])} unregistered "
             f"cross-thread access(es), merged cycles: "
             f"{result['cross_check']['merged_cycles']}, "
+            f"modelcheck {result['proto']['modelcheck_points']} point(s)/"
+            f"{result['proto']['modelcheck_injections']} injection(s), "
             f"schedule {result['schedule_sha256'][:12]} — "
             + ("OK" if result["ok"] else "FAIL")
         )

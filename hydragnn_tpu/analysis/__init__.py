@@ -11,9 +11,15 @@
 * ``graftrace`` — static lock-discipline + thread-topology analyzer over the
   host concurrency layer (concurrency.py), with an opt-in runtime
   sanitizer half (tsan.py, ``HYDRAGNN_TSAN=1``).
+* ``graftproto`` — static SPMD/barrier lockstep analyzer over the distributed
+  control plane (proto.py: collective-lockstep, barrier-protocol,
+  incarnation-contract), with a crash-consistency model checker as its
+  runtime half (mck.py, ``modelcheck``).
 
 CLI: ``python -m hydragnn_tpu.analysis`` lints the package;
-``python -m hydragnn_tpu.analysis check-config <json>`` checks a config.
+``python -m hydragnn_tpu.analysis check-config <json>`` checks a config;
+``proto`` / ``modelcheck`` / ``suppressions`` run the graftproto passes and
+the suppression audit.
 
 This package deliberately imports nothing heavy at module scope — the linter
 half must stay usable (and fast) in contexts that never touch jax.
@@ -30,11 +36,15 @@ from .baseline import (
 from .concurrency import TraceReport, trace_paths
 from .contracts import ConfigContractError, check_config, gate_config
 from .graftlint import Report, Violation, lint_paths
+from .mck import CrashInjected, model_check
+from .proto import ProtoReport, proto_paths
 from .sentinel import RecompileError, compile_count, no_recompile
 
 __all__ = [
     "ConfigContractError",
+    "CrashInjected",
     "DEFAULT_BASELINE_PATH",
+    "ProtoReport",
     "RecompileError",
     "Report",
     "TraceReport",
@@ -44,8 +54,10 @@ __all__ = [
     "gate_config",
     "lint_paths",
     "load_baseline",
+    "model_check",
     "new_violations",
     "no_recompile",
+    "proto_paths",
     "save_baseline",
     "trace_paths",
 ]

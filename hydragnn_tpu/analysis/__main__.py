@@ -13,6 +13,23 @@
     python -m hydragnn_tpu.analysis check-config <config.json>
         [--mode training|serving] [--bucket-ladder NxE,NxE] [--json]
         Static contract check; exit 0 iff the config passes.
+
+    python -m hydragnn_tpu.analysis proto [paths...] [--json]
+        graftproto alone: collective-lockstep, barrier-protocol and
+        incarnation-contract rules over the distributed control plane.
+        Exit 0 iff clean vs baseline (collective-divergence and
+        torn-state-hazard are never baselineable).
+
+    python -m hydragnn_tpu.analysis modelcheck [--smoke] [--seed N]
+        [--scenario NAME ...] [--json]
+        Crash-consistency model checker: inject a crash at every
+        auto-discovered persistence point across the elastic/swap/flywheel
+        state machines and assert the recovery invariants. Exit 0 iff all
+        injections recover clean.
+
+    python -m hydragnn_tpu.analysis suppressions [paths...] [--json]
+        Audit every inline graftlint:/graftrace:/graftproto: disable
+        (file:line, rule, reason). Exit 0 iff none is reason-less.
 """
 
 from __future__ import annotations
@@ -27,7 +44,9 @@ from . import (
     check_config,
     lint_paths,
     load_baseline,
+    model_check,
     new_violations,
+    proto_paths,
     save_baseline,
     trace_paths,
 )
@@ -54,20 +73,21 @@ def _lint_main(args) -> int:
     baseline = load_baseline(args.baseline)
     fresh = new_violations(report, baseline)
     if args.update_baseline:
-        # A lint-only rewrite must not clobber the trace pass's entries in
-        # the shared file (the combined run rewrites everything); entries
-        # this report re-emits are dropped so counts don't inflate.
+        # A lint-only rewrite must not clobber the trace OR proto passes'
+        # entries in the shared file (the combined run still only covers
+        # lint+trace, so proto rows are always preserved); entries this
+        # report re-emits are dropped so counts don't inflate.
         report_keys = {v.key for v in report.violations}
-        preserve = (
-            {
-                k: n
-                for k, n in baseline.items()
-                if k.rsplit("::", 1)[-1] in R.CONCURRENCY_RULES
-                and k not in report_keys
-            }
-            if trace is None
-            else None
+        other_rules = (
+            R.PROTO_RULES
+            if trace is not None
+            else (R.CONCURRENCY_RULES | R.PROTO_RULES)
         )
+        preserve = {
+            k: n
+            for k, n in baseline.items()
+            if k.rsplit("::", 1)[-1] in other_rules and k not in report_keys
+        }
         entries = save_baseline(report, args.baseline, preserve=preserve)
         print(f"baseline updated: {len(entries)} entrie(s) at {args.baseline}")
         return 0
@@ -172,6 +192,118 @@ def _trace_main(args) -> int:
     return 1 if fresh else 0
 
 
+def _proto_main(args) -> int:
+    paths = args.paths or [_PACKAGE_DIR]
+    root = os.path.dirname(_PACKAGE_DIR)
+    report = proto_paths(paths, root=root)
+    baseline = load_baseline(args.baseline)
+    fresh = new_violations(report, baseline)
+    if args.update_baseline:
+        # This rewrite only owns the proto rules' rows in the shared file.
+        report_keys = {v.key for v in report.violations}
+        preserve = {
+            k: n
+            for k, n in baseline.items()
+            if k.rsplit("::", 1)[-1] not in R.PROTO_RULES
+            and k not in report_keys
+        }
+        entries = save_baseline(report, args.baseline, preserve=preserve)
+        print(f"baseline updated: {len(entries)} entrie(s) at {args.baseline}")
+        return 0
+    if args.json:
+        doc = {
+            "files": report.files,
+            "rule_counts": report.counts(),
+            "violations": [v.format() for v in report.violations],
+            "new_violations": [v.format() for v in fresh],
+            "suppressed": [v.format() for v in report.suppressed],
+            "lockstep_segments": report.lockstep_segments,
+            "barrier_sequences": report.barrier_sequences,
+            "persistence_points": report.persistence_points,
+            "collective_functions": report.collective_functions,
+            "ok": not fresh,
+        }
+        print(json.dumps(doc))
+    else:
+        for v in report.violations:
+            marker = "" if v.key in baseline else " [NEW]"
+            print(v.format() + marker)
+        for v in report.suppressed:
+            print(v.format() + f" — reason: {v.reason}")
+        segs = ", ".join(sorted(report.lockstep_segments)) or "<none>"
+        print(
+            f"graftproto: {report.files} file(s); lockstep segments: {segs}; "
+            f"{len(report.persistence_points)} persistence point(s), "
+            f"{len(report.collective_functions)} collective function(s), "
+            f"{len(report.violations)} violation(s) ({len(fresh)} new), "
+            f"{len(report.suppressed)} suppressed"
+        )
+    return 1 if fresh else 0
+
+
+def _modelcheck_main(args) -> int:
+    verdict = model_check(
+        seed=args.seed, smoke=args.smoke, scenarios=args.scenario or None
+    )
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        for p in verdict["points"]:
+            novel = " [novel]" if p in verdict.get("novel_points", ()) else ""
+            print(f"modelcheck: point {p}{novel}")
+        for f in verdict["failures"]:
+            print(f"modelcheck: FAILED {f}")
+        status = "OK" if verdict["ok"] else "FAILED"
+        print(
+            f"modelcheck: {status} — {verdict.get('num_points', 0)} "
+            f"persistence point(s), {verdict.get('num_injections', 0)} "
+            f"injection(s) over {len(verdict['scenarios'])} scenario(s), "
+            f"schedule {str(verdict.get('schedule_sha256'))[:12]}"
+        )
+    return 0 if verdict["ok"] else 1
+
+
+def _suppressions_main(args) -> int:
+    from .graftlint import Linter, Report
+
+    paths = args.paths or [_PACKAGE_DIR]
+    root = os.path.dirname(_PACKAGE_DIR)
+    linter = Linter(paths, root=root)
+    linter.load(Report())
+    rows = []
+    for mod in linter.modules:
+        for line, (rule, reason) in sorted(mod.suppressions.items()):
+            rows.append(
+                {
+                    "file": mod.relpath,
+                    "line": line,
+                    "rule": rule,
+                    "reason": reason or None,
+                }
+            )
+    rows.sort(key=lambda r: (r["file"], r["line"]))
+    reasonless = [r for r in rows if not r["reason"]]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "suppressions": rows,
+                    "count": len(rows),
+                    "reasonless": reasonless,
+                    "ok": not reasonless,
+                }
+            )
+        )
+    else:
+        for r in rows:
+            why = r["reason"] or "<NO REASON — fix or remove>"
+            print(f"{r['file']}:{r['line']}: {r['rule']} — {why}")
+        print(
+            f"suppressions: {len(rows)} total, {len(reasonless)} reason-less"
+        )
+    return 1 if reasonless else 0
+
+
 def _check_config_main(args) -> int:
     ladder = None
     if args.bucket_ladder:
@@ -234,6 +366,33 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--json", action="store_true")
     tr.add_argument("--baseline", default=DEFAULT_BASELINE_PATH)
     tr.add_argument("--update-baseline", action="store_true")
+    pr = sub.add_parser(
+        "proto", help="graftproto: SPMD/barrier lockstep + incarnation contract"
+    )
+    pr.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
+    pr.add_argument("--json", action="store_true")
+    pr.add_argument("--baseline", default=DEFAULT_BASELINE_PATH)
+    pr.add_argument("--update-baseline", action="store_true")
+    mc = sub.add_parser(
+        "modelcheck", help="crash-consistency model checker (graftproto runtime)"
+    )
+    mc.add_argument("--seed", type=int, default=0)
+    mc.add_argument(
+        "--smoke",
+        action="store_true",
+        help="CI-bounded subset: elastic shrink + swap promote",
+    )
+    mc.add_argument(
+        "--scenario",
+        action="append",
+        help="run only the named scenario(s) (repeatable)",
+    )
+    mc.add_argument("--json", action="store_true")
+    sp = sub.add_parser(
+        "suppressions", help="audit inline disables across all three grammars"
+    )
+    sp.add_argument("paths", nargs="*", help="files/dirs (default: the package)")
+    sp.add_argument("--json", action="store_true")
     cc = sub.add_parser("check-config", help="static config contract check")
     cc.add_argument("config")
     cc.add_argument(
@@ -253,13 +412,29 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # Default subcommand: bare invocation (or paths/flags only) means lint.
-    if not argv or argv[0] not in ("lint", "trace", "check-config", "-h", "--help"):
+    known = (
+        "lint",
+        "trace",
+        "proto",
+        "modelcheck",
+        "suppressions",
+        "check-config",
+        "-h",
+        "--help",
+    )
+    if not argv or argv[0] not in known:
         argv = ["lint"] + argv
     args = build_parser().parse_args(argv)
     if args.cmd == "check-config":
         return _check_config_main(args)
     if args.cmd == "trace":
         return _trace_main(args)
+    if args.cmd == "proto":
+        return _proto_main(args)
+    if args.cmd == "modelcheck":
+        return _modelcheck_main(args)
+    if args.cmd == "suppressions":
+        return _suppressions_main(args)
     return _lint_main(args)
 
 

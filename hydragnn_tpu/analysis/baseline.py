@@ -20,11 +20,16 @@ from .graftlint import Report, Violation
 # Rules that may never carry baseline entries. unguarded-shared-write joins
 # the original two (ISSUE 8): a grandfathered lost-update race corrupts
 # counters/caches silently — it must be fixed or inline-suppressed with a
-# reason, never tolerated by count.
+# reason, never tolerated by count. collective-divergence and
+# torn-state-hazard join them (ISSUE 19): a grandfathered rank-divergent
+# collective deadlocks the first real multi-host mesh, and a grandfathered
+# torn-state window silently corrupts every crash recovery after it.
 NO_BASELINE_RULES = (
     "host-sync-in-step",
     "cond-in-guard",
     "unguarded-shared-write",
+    "collective-divergence",
+    "torn-state-hazard",
 )
 
 DEFAULT_BASELINE_PATH = os.path.join(

@@ -26,6 +26,14 @@ Every failure is one actionable line tagged with a stable code:
                     contract explicitly disabled, unknown grad_sync arm,
                     non-positive grad bucket size, elastic worker-range
                     knobs that cannot be satisfied) — docs/DISTRIBUTED.md
+  bad-elastic-timing  elastic liveness timing that silently turns a slow
+                    epoch into a hang-kill: heartbeat_s at or under the
+                    pump's tick resolution (interval_s = heartbeat_s/4), or
+                    heartbeat_s at or above the ProxyRendezvous wire
+                    deadlines (post 10 s, barrier 300 s) — the coordinator
+                    would drop a healthy worker's connection before its
+                    next beat could land — docs/DISTRIBUTED.md "Elastic
+                    runbook"
   bad-router        multi-replica router config nonsense (replica count /
                     hash-ring weights / admission classes without deadlines /
                     fleet ladder-memory blowout) — docs/SERVING.md
@@ -1125,6 +1133,49 @@ def _check_mesh(training, deep, errors):
                 "number of seconds",
             )
         )
+    elif hb is not None:
+        # Liveness timing (bad-elastic-timing): the HeartbeatPump posts
+        # every heartbeat_s/4 and the supervisor declares a worker dead
+        # after ~heartbeat_s without a beat, while the ProxyRendezvous wire
+        # path enforces its own read/write deadlines. A heartbeat window
+        # that does not fit strictly inside those deadlines (or a pump tick
+        # below timer resolution) silently turns every slow epoch into a
+        # hang-kill — flag it here, before any worker spawns.
+        from ..parallel.loopback import _BARRIER_TIMEOUT_S, _POST_TIMEOUT_S
+
+        pump_s = hb / 4.0
+        if pump_s < 0.05:
+            errors.append(
+                (
+                    "bad-elastic-timing",
+                    f"Training.elastic.heartbeat_s={hb} puts the heartbeat "
+                    f"pump interval at {pump_s:.3g}s (heartbeat_s/4) — "
+                    "below timer resolution, the pump cannot hold the "
+                    "margin; raise heartbeat_s to at least 0.2",
+                )
+            )
+        if hb >= _POST_TIMEOUT_S:
+            errors.append(
+                (
+                    "bad-elastic-timing",
+                    f"Training.elastic.heartbeat_s={hb} is not strictly "
+                    f"under the ProxyRendezvous post deadline "
+                    f"({_POST_TIMEOUT_S:g}s) — a beat delayed by one slow "
+                    "post RPC overshoots the liveness window and the "
+                    "supervisor kills a healthy worker",
+                )
+            )
+        if hb >= _BARRIER_TIMEOUT_S:
+            errors.append(
+                (
+                    "bad-elastic-timing",
+                    f"Training.elastic.heartbeat_s={hb} is not strictly "
+                    f"under the ProxyRendezvous barrier deadline "
+                    f"({_BARRIER_TIMEOUT_S:g}s) — the rendezvous would time "
+                    "out a world that is merely waiting for the next "
+                    "heartbeat-paced quiesce",
+                )
+            )
 
 
 # ---------------------------------------------------------- aggregation path

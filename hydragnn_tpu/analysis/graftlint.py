@@ -34,11 +34,12 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import rules as R
 
-# One suppression grammar for both passes: comments of the form
-# ``graft{lint,race}: disable=<rule>(<why>)`` are interchangeable (the rule
-# id decides which pass it addresses; rules.RULES is the single catalogue).
+# One suppression grammar for all three passes: comments of the form
+# ``graft{lint,race,proto}: disable=<rule>(<why>)`` are interchangeable (the
+# rule id decides which pass it addresses; rules.RULES is the single
+# catalogue).
 _SUPPRESS_RE = re.compile(
-    r"#\s*graft(?:lint|race):\s*disable=([A-Za-z0-9_-]+)\s*(?:\(([^)]*)\))?"
+    r"#\s*graft(?:lint|race|proto):\s*disable=([A-Za-z0-9_-]+)\s*(?:\(([^)]*)\))?"
 )
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -600,6 +601,32 @@ class Linter:
             self._check_nondeterminism(mod, fn, report, collation)
             self._check_donation(mod, fn, report)
             self._check_recompile_fn(mod, fn, report)
+            self._check_pickle_load(mod, fn, report)
+
+    # --- pickle-load-outside-compat
+    def _check_pickle_load(
+        self, mod: ModuleInfo, fn: FuncInfo, report: Report
+    ) -> None:
+        """The raw-pickle read path was deprecated in PR 16 (the GSHD convert
+        CLI replaced it with digest-verified containers). EVERY surviving
+        pickle.load/pickle.loads/torch.load site is a sanctioned v1-compat
+        shim and carries a reasoned inline suppression; a new call site
+        without one is a regression."""
+        for node in _own_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.canonical(_dotted(node.func))
+            if canon in R.PICKLE_LOAD_CALLS:
+                self._emit(
+                    report,
+                    mod,
+                    "pickle-load-outside-compat",
+                    node,
+                    f"{canon}() outside the sanctioned v1-compat shims — "
+                    "the raw-pickle read path is deprecated (use the GSHD "
+                    "convert CLI / digest-verified containers)",
+                    fn.qualname,
+                )
 
     # --- host-sync-in-step
     def _check_host_sync(

@@ -1,0 +1,542 @@
+"""graftproto's runtime half: a crash-consistency model checker for the
+distributed control plane (``python -m hydragnn_tpu.analysis modelcheck``).
+
+The fault drills (ELASTIC_r15 / SWAP_r13 / FLYWHEEL_r17) each kill the
+process at ONE hand-picked point — the save, the promote persist, the
+pre-persist hook. This module generalizes the tsan seeded-schedule idea to
+crash schedules: every atomic persistence funnel
+(:func:`~hydragnn_tpu.checkpoint.io.atomic_write_json`,
+:func:`~hydragnn_tpu.checkpoint.io.write_checkpoint_blob`,
+:func:`~hydragnn_tpu.checkpoint.io.atomic_copy_file`) is intercepted, the
+control-plane scenarios are run once to RECORD which persistence points they
+actually reach (auto-discovery — nothing is hand-picked), and then each
+scenario is re-run once per (point, mode) with a fault injected there:
+
+* ``kill`` — :class:`CrashInjected` (a ``BaseException``, so no
+  ``except Exception`` in the code under test can absorb it) raised BEFORE
+  the atomic install: the bytes must simply not exist afterwards.
+* ``exception`` — the install completes, then a ``RuntimeError`` aborts the
+  caller mid-flight: the bytes ARE durable but every in-memory step after
+  the install was lost.
+
+After every injection, recovery runs from DISK ONLY (a fresh
+:class:`~hydragnn_tpu.lifecycle.registry.ModelRegistry`, a fresh
+:func:`~hydragnn_tpu.checkpoint.io.load_verified_chain`) and the standing
+invariants are asserted:
+
+* **roles untorn** — the lifecycle sidecar parses, and every role it names
+  resolves to an intact, digest-verified file of a KNOWN version;
+* **restore ∈ save_log** — the recovered training step is one the scenario
+  actually attempted to save, and no completed save is ever lost
+  (monotonicity);
+* **sample-multiset conservation** — the elastic resume descriptor, resharded
+  to the new world size, schedules every remaining batch exactly once;
+* **quarantine integrity** — a rejected candidate's forensic copy is either
+  absent or byte-identical to the source, never torn.
+
+Determinism: the injection schedule is ordered by
+``sha256(f"{seed}:{scenario}:{point}:{mode}")`` (same construction as the
+tsan drill's seeded scheduler) and the whole schedule is fingerprinted as
+``schedule_sha256`` — two runs with the same seed must match, which
+tests/test_proto_lint.py pins as the determinism witness.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["CrashInjected", "model_check", "SCENARIOS", "SMOKE_SCENARIOS"]
+
+
+class CrashInjected(BaseException):
+    """A simulated SIGKILL at a persistence point. Deliberately a
+    ``BaseException``: the code under test is full of honest
+    ``except Exception`` recovery blocks, and a real SIGKILL is not
+    catchable — the simulation must not be either."""
+
+
+# Crash points the existing drills already cover with hand-picked kills
+# (ELASTIC_r15 kills at save, SWAP_r13/FLYWHEEL_r17 kill around the promote
+# persist via the pre-persist hook). Everything else the checker discovers
+# is NEW coverage — ANALYSIS_r19.json reports the delta.
+KNOWN_DRILLED_POINTS = frozenset(
+    {
+        "write_checkpoint_blob@save_model",
+        "atomic_write_json@_persist<commit_promote",
+        "atomic_write_json@_persist<commit_rollback",
+    }
+)
+
+_FUNNEL_NAMES = ("atomic_write_json", "write_checkpoint_blob", "atomic_copy_file")
+
+
+# --------------------------------------------------------------- interception
+@dataclass
+class _Injector:
+    """One armed fault (or a recording pass when ``mode == 'record'``)."""
+
+    mode: str  # "record" | "kill" | "exception"
+    target: Optional[str] = None
+    # A point reached N times in a scenario (e.g. two saves through
+    # write_checkpoint_blob@save_model) yields N injections — crashing the
+    # SECOND save is the case that proves the first survives.
+    target_occurrence: int = 0
+    fired: bool = False
+    recorded: List[str] = field(default_factory=list)
+    seen: Dict[str, int] = field(default_factory=dict)
+
+
+_CURRENT: Optional[_Injector] = None
+
+
+def _point_id(funnel: str) -> str:
+    """Identity of the persistence point = which funnel, called from which
+    function. ``ModelRegistry._persist`` is a fan-in (five role flips all
+    persist through it), so its points carry the grand-caller too:
+    ``atomic_write_json@_persist<commit_promote``."""
+    frame = sys._getframe(2)  # skip _point_id + the wrapper
+    names: List[str] = []
+    while frame is not None and len(names) < 2:
+        code = frame.f_code
+        path = code.co_filename.replace(os.sep, "/")
+        if "hydragnn_tpu" in path and "/analysis/mck" not in path:
+            names.append(code.co_name)
+        frame = frame.f_back
+    caller = names[0] if names else "<external>"
+    point = f"{funnel}@{caller}"
+    if caller == "_persist" and len(names) > 1:
+        point += f"<{names[1]}"
+    return point
+
+
+def _wrap(funnel: str, orig: Callable[..., Any]) -> Callable[..., Any]:
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        inj = _CURRENT
+        if inj is None:
+            return orig(*args, **kwargs)
+        point = _point_id(funnel)
+        if inj.mode == "record":
+            inj.recorded.append(point)
+            return orig(*args, **kwargs)
+        if point == inj.target and not inj.fired:
+            occ = inj.seen.get(point, 0)
+            inj.seen[point] = occ + 1
+            if occ == inj.target_occurrence:
+                inj.fired = True
+                if inj.mode == "kill":
+                    raise CrashInjected(point)
+                orig(*args, **kwargs)
+                raise RuntimeError(f"mck post-install fault at {point}")
+        return orig(*args, **kwargs)
+
+    wrapper.__name__ = f"_mck_{funnel}"
+    return wrapper
+
+
+class _Patched:
+    """Context manager installing the funnel wrappers. Besides the
+    ``checkpoint.io`` module attributes, ``lifecycle/registry.py`` imports
+    ``atomic_write_json`` BY NAME at import time, so its module global is
+    rebound too (and restored on exit)."""
+
+    def __enter__(self) -> "_Patched":
+        from ..checkpoint import io as ckpt_io
+        from ..lifecycle import registry as lifecycle_registry
+
+        self._io = ckpt_io
+        self._registry = lifecycle_registry
+        self._saved_io = {n: getattr(ckpt_io, n) for n in _FUNNEL_NAMES}
+        self._saved_reg = lifecycle_registry.atomic_write_json
+        for n, orig in self._saved_io.items():
+            setattr(ckpt_io, n, _wrap(n, orig))
+        lifecycle_registry.atomic_write_json = _wrap(
+            "atomic_write_json", self._saved_reg
+        )
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        for n, orig in self._saved_io.items():
+            setattr(self._io, n, orig)
+        self._registry.atomic_write_json = self._saved_reg
+
+
+# ------------------------------------------------------------------ scenarios
+def _variables(fill: float) -> Dict[str, Any]:
+    import numpy as np
+
+    return {
+        "params": {
+            "dense": {
+                "kernel": np.full((2, 3), fill, dtype=np.float32),
+                "bias": np.zeros((3,), dtype=np.float32),
+            }
+        }
+    }
+
+
+@dataclass
+class _Ctx:
+    """Per-injection world: a fresh directory plus the scenario's honest
+    save log (``attempts`` appended BEFORE each save call, ``completed``
+    after it returns — the durable-but-aborted ``exception`` mode lands in
+    the gap between the two)."""
+
+    tmp: str
+    name: str = "mck_model"
+    attempts: List[int] = field(default_factory=list)
+    completed: List[int] = field(default_factory=list)
+    valid_versions: List[str] = field(default_factory=list)
+    quarantine_src: Optional[str] = None
+    quarantine_dst: Optional[str] = None
+
+    @property
+    def run_dir(self) -> str:
+        # save_model(path=tmp, name=name) writes into <tmp>/<name>/
+        return os.path.join(self.tmp, self.name)
+
+
+def _save(ctx: _Ctx, fill: float, step: int, *, world: int = 4,
+          epoch: int = 1, cursor: int = 3, num_batches: int = 8) -> str:
+    from ..checkpoint.io import elastic_handoff_meta, save_model
+
+    meta = {
+        "epoch": epoch,
+        "elastic": elastic_handoff_meta(
+            world_size=world,
+            epoch=epoch,
+            cursor=cursor,
+            incarnation=0,
+            global_step=step,
+            num_batches=num_batches,
+        ),
+    }
+    ctx.attempts.append(step)
+    save_model(
+        _variables(fill), None, ctx.name, path=ctx.tmp, meta=meta,
+        keep_last_k=2,
+    )
+    ctx.completed.append(step)
+    return os.path.join(ctx.run_dir, ctx.name + ".pk")
+
+
+def _scenario_elastic(ctx: _Ctx) -> None:
+    """Two elastic saves at world 4 (step 100 then 200): the checkpoint the
+    shrink-to-world-2 restore hands off from. A crash at the second save
+    must recover the first, byte-intact."""
+    _save(ctx, 1.0, 100, epoch=1, cursor=3)
+    _save(ctx, 2.0, 200, epoch=2, cursor=5)
+
+
+def _scenario_swap_promote(ctx: _Ctx) -> None:
+    from ..lifecycle.registry import ModelRegistry
+
+    p1 = _save(ctx, 1.0, 100, epoch=1)
+    reg = ModelRegistry(ctx.run_dir, ctx.name)
+    reg.set_live(p1)
+    ctx.valid_versions.append(reg.live.version)
+    p2 = _save(ctx, 2.0, 200, epoch=2)
+    ctx.valid_versions.append(reg.identify(p2).version)
+    mv = reg.stage_candidate()
+    reg.commit_promote(mv)
+
+
+def _scenario_swap_rollback(ctx: _Ctx) -> None:
+    from ..lifecycle.registry import ModelRegistry
+
+    p1 = _save(ctx, 1.0, 100, epoch=1)
+    reg = ModelRegistry(ctx.run_dir, ctx.name)
+    reg.set_live(p1)
+    old = reg.live
+    ctx.valid_versions.append(old.version)
+    p2 = _save(ctx, 2.0, 200, epoch=2)
+    ctx.valid_versions.append(reg.identify(p2).version)
+    mv = reg.stage_candidate()
+    reg.commit_promote(mv)
+    reg.commit_rollback(old)
+
+
+def _scenario_flywheel_staging(ctx: _Ctx) -> None:
+    """The flywheel rejection path: stage → quarantine the bytes (through
+    the REAL ``Flywheel._quarantine``, driven unbound on a stub so the
+    forensic copy exercises the exact shipping code) → clear the candidate."""
+    from ..flywheel.loop import Flywheel
+    from ..lifecycle.registry import ModelRegistry
+
+    p1 = _save(ctx, 1.0, 100, epoch=1)
+    reg = ModelRegistry(ctx.run_dir, ctx.name)
+    reg.set_live(p1)
+    ctx.valid_versions.append(reg.live.version)
+    p2 = _save(ctx, 2.0, 200, epoch=2)
+    ctx.valid_versions.append(reg.identify(p2).version)
+    mv = reg.stage_candidate()
+    stub = SimpleNamespace(
+        run_dir=ctx.run_dir,
+        config=SimpleNamespace(quarantine_dir="quarantine"),
+    )
+    ctx.quarantine_src = mv.path
+    ctx.quarantine_dst = Flywheel._quarantine(stub, mv)
+    if ctx.quarantine_dst is None:
+        ctx.quarantine_dst = os.path.join(
+            ctx.run_dir, "quarantine", f"{mv.short}.pk"
+        )
+    reg.clear_candidate(reason="mck: shadow gate red")
+
+
+SCENARIOS: Dict[str, Callable[[_Ctx], None]] = {
+    "elastic": _scenario_elastic,
+    "swap_promote": _scenario_swap_promote,
+    "swap_rollback": _scenario_swap_rollback,
+    "flywheel_staging": _scenario_flywheel_staging,
+}
+# The CI smoke subset (static-analysis.yml): elastic shrink + swap promote.
+SMOKE_SCENARIOS = ("elastic", "swap_promote")
+
+
+# ------------------------------------------------------------------ recovery
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _verify(ctx: _Ctx, new_world: int = 2) -> List[str]:
+    """Recovery + invariants, from disk only. Returns failure strings."""
+    from ..checkpoint.format import CheckpointError
+    from ..checkpoint.io import load_verified_chain, verify_elastic_handoff
+    from ..lifecycle.registry import ModelRegistry
+
+    failures: List[str] = []
+
+    # --- restore ∈ save_log + monotonicity -------------------------------
+    meta: Optional[Dict[str, Any]] = None
+    try:
+        _vars, _opt, meta, _report = load_verified_chain(
+            _variables(0.0), ctx.run_dir, ctx.name
+        )
+    except CheckpointError:
+        if ctx.completed:
+            failures.append(
+                "restore: no checkpoint recoverable although "
+                f"saves {ctx.completed} completed"
+            )
+    except FileNotFoundError:
+        if ctx.completed:
+            failures.append(
+                f"restore: checkpoint files missing after {ctx.completed}"
+            )
+    if meta is not None:
+        step = (meta.get("elastic") or {}).get("global_step")
+        if step not in ctx.attempts:
+            failures.append(
+                f"restore: recovered step {step!r} was never saved "
+                f"(attempts={ctx.attempts})"
+            )
+        elif ctx.completed and step < max(ctx.completed):
+            failures.append(
+                f"restore: recovered step {step} loses completed save "
+                f"{max(ctx.completed)}"
+            )
+        # --- sample-multiset conservation across the world change --------
+        try:
+            resume = verify_elastic_handoff(meta, new_world)
+        except CheckpointError as e:
+            failures.append(f"handoff: {e}")
+        else:
+            cursor = resume["cursor"]
+            num = (meta.get("elastic") or {}).get("num_batches", 0)
+            remaining = list(range(cursor, num))
+            scheduled = sorted(
+                b
+                for rank in range(new_world)
+                for b in remaining[rank::new_world]
+            )
+            if scheduled != remaining:
+                failures.append(
+                    f"conservation: reshard to world {new_world} schedules "
+                    f"{scheduled} != remaining {remaining}"
+                )
+
+    # --- roles untorn ----------------------------------------------------
+    try:
+        reg = ModelRegistry(ctx.run_dir, ctx.name)
+        state = reg.state()
+    except Exception as e:  # noqa: BLE001 — any load failure is a torn sidecar
+        failures.append(f"roles: lifecycle sidecar unreadable ({e})")
+    else:
+        for role in ("live", "candidate", "previous"):
+            doc = state["roles"].get(role)
+            if not doc:
+                continue
+            try:
+                mv = reg.identify(doc["path"])
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"roles: {role} unverifiable ({e})")
+                continue
+            if ctx.valid_versions and mv.version not in ctx.valid_versions:
+                failures.append(
+                    f"roles: {role} carries unknown version {mv.short}"
+                )
+
+    # --- quarantine integrity --------------------------------------------
+    if ctx.quarantine_dst and os.path.exists(ctx.quarantine_dst):
+        if ctx.quarantine_src and os.path.exists(ctx.quarantine_src):
+            if _sha256_file(ctx.quarantine_dst) != _sha256_file(
+                ctx.quarantine_src
+            ):
+                failures.append(
+                    "quarantine: forensic copy is torn (digest mismatch "
+                    "with source)"
+                )
+    qdir = os.path.join(ctx.run_dir, "quarantine")
+    if os.path.isdir(qdir):
+        # a crash may leave a writer-owned .tmp — never a torn final file
+        for f in os.listdir(qdir):
+            if f.endswith(".pk") and ctx.quarantine_dst and os.path.join(
+                qdir, f
+            ) != ctx.quarantine_dst:
+                failures.append(f"quarantine: unexpected final file {f}")
+    return failures
+
+
+# ------------------------------------------------------------------- driver
+def _run_once(
+    scenario: str, injector: Optional[_Injector]
+) -> Tuple[str, List[str], _Ctx]:
+    """One scenario execution in a fresh world. Returns
+    (outcome, invariant_failures, ctx)."""
+    global _CURRENT
+    fn = SCENARIOS[scenario]
+    with tempfile.TemporaryDirectory(prefix="mck_") as tmp:
+        ctx = _Ctx(tmp=tmp)
+        outcome = "completed"
+        _CURRENT = injector
+        try:
+            fn(ctx)
+        except CrashInjected:
+            outcome = "crashed"
+        except RuntimeError as e:
+            outcome = (
+                "faulted" if "mck post-install fault" in str(e) else "error"
+            )
+            if outcome == "error":
+                raise
+        finally:
+            _CURRENT = None
+        failures = _verify(ctx)
+        return outcome, failures, ctx
+
+
+def model_check(
+    seed: int = 0,
+    smoke: bool = False,
+    scenarios: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """Enumerate crash injections at every auto-discovered persistence point
+    and return the verdict document (``bench.py --analyze`` commits it into
+    ANALYSIS_r19.json)."""
+    names = list(
+        scenarios
+        if scenarios is not None
+        else (SMOKE_SCENARIOS if smoke else SCENARIOS)
+    )
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise ValueError(
+            f"unknown scenario(s) {unknown}; known: {sorted(SCENARIOS)}"
+        )
+
+    with _Patched():
+        # Pass 1: auto-discover the persistence points each scenario reaches.
+        discovered: Dict[str, List[str]] = {}
+        for name in names:
+            rec = _Injector(mode="record")
+            outcome, failures, _ctx = _run_once(name, rec)
+            if outcome != "completed" or failures:
+                return {
+                    "ok": False,
+                    "seed": seed,
+                    "scenarios": names,
+                    "failures": [
+                        f"baseline {name}: outcome={outcome} {failures}"
+                    ],
+                    "points": [],
+                    "injections": [],
+                    "schedule_sha256": None,
+                }
+            discovered[name] = rec.recorded
+
+        # Pass 2: the seeded crash schedule — one injection per
+        # (scenario, point, OCCURRENCE, mode), ordered by the seed-keyed
+        # digest. A point a scenario reaches twice (two saves through the
+        # same funnel) is crashed at each visit: killing the second save is
+        # what proves the first survives.
+        plan: List[Tuple[str, str, int, str]] = []
+        for name in names:
+            counts: Dict[str, int] = {}
+            for point in discovered[name]:
+                occ = counts.get(point, 0)
+                counts[point] = occ + 1
+                for mode in ("kill", "exception"):
+                    plan.append((name, point, occ, mode))
+        plan.sort(
+            key=lambda t: hashlib.sha256(
+                f"{seed}:{t[0]}:{t[1]}:{t[2]}:{t[3]}".encode()
+            ).hexdigest()
+        )
+        schedule = [
+            {"scenario": s, "point": p, "occurrence": o, "mode": m}
+            for s, p, o, m in plan
+        ]
+        schedule_sha256 = hashlib.sha256(
+            json.dumps(schedule, sort_keys=True).encode()
+        ).hexdigest()
+
+        injections: List[Dict[str, Any]] = []
+        failures: List[str] = []
+        for name, point, occ, mode in plan:
+            inj = _Injector(mode=mode, target=point, target_occurrence=occ)
+            outcome, inv_failures, _ctx = _run_once(name, inj)
+            if not inj.fired:
+                inv_failures = inv_failures + [
+                    f"schedule: point {point}#{occ} not reached on replay"
+                ]
+            injections.append(
+                {
+                    "scenario": name,
+                    "point": point,
+                    "occurrence": occ,
+                    "mode": mode,
+                    "fired": inj.fired,
+                    "outcome": outcome,
+                    "invariant_failures": inv_failures,
+                }
+            )
+            failures.extend(
+                f"{name}/{point}#{occ}/{mode}: {f}" for f in inv_failures
+            )
+
+    all_points = sorted({p for pts in discovered.values() for p in pts})
+    novel = sorted(set(all_points) - KNOWN_DRILLED_POINTS)
+    return {
+        "ok": not failures,
+        "seed": seed,
+        "scenarios": names,
+        "points": all_points,
+        "num_points": len(all_points),
+        "points_per_scenario": discovered,
+        "novel_points": novel,
+        "known_drilled": sorted(KNOWN_DRILLED_POINTS & set(all_points)),
+        "num_injections": len(injections),
+        "injections": injections,
+        "schedule_sha256": schedule_sha256,
+        "failures": failures,
+    }

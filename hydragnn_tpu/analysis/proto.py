@@ -1,0 +1,842 @@
+"""graftproto — static SPMD/barrier lockstep + incarnation-contract analyzer
+for the distributed control plane (rule catalogue: rules.py, policy +
+examples: docs/STATIC_ANALYSIS.md "graftproto").
+
+graftlint covers in-jit discipline and graftrace covers thread/lock
+discipline; this third leg covers the CROSS-RANK and CROSS-INCARNATION
+layer the elastic/swap/flywheel state machines (PRs 13–17) introduced —
+the protocols whose failure mode is not a wrong number but a mesh that
+deadlocks on real multi-host hardware or a crash recovery that reads torn
+state. Three rule families over the same parsed-module/call-graph
+infrastructure (ProtoAnalyzer subclasses concurrency.Tracer, which
+subclasses graftlint.Linter):
+
+1. **Collective lockstep** (``collective-divergence``, never baselineable).
+   XLA collectives (psum/pmean/ppermute/all_gather/...) are compiled into
+   a fixed program; every rank must trace the IDENTICAL sequence. Inside
+   traced code, any Python-level branch conditioned on a rank-identity
+   name (rules.RANK_GUARD_NAMES), and any branch whose arms trace
+   DIFFERENT transitive collective sequences while its condition depends
+   on the function's own parameters, makes the sequence path-dependent.
+   Closure/global names in a branch condition are trace-time constants
+   (every rank closes over the same config) and stay clean — that is what
+   keeps ``overlap.make_reduce``'s ``grad_sync`` dispatch legal.
+
+2. **Barrier protocol** (``barrier-divergence``, ``barrier-under-lock``,
+   ``leader-only-barrier``). Named rendezvous barrier sites are extracted
+   per thread/lockstep root (graftrace's topology roots plus the
+   ``run_workers`` lockstep segments — rules.LOCKSTEP_CALLABLE_BINDINGS,
+   the runs-as-every-rank analog of THREAD_CALLABLE_BINDINGS). All members
+   of one segment must reach the same barrier-name sequence
+   (``barrier-divergence``); a barrier statically inside a ``with <lock>:``
+   whose lock another root also acquires is a distributed convoy
+   (``barrier-under-lock``); a barrier reachable only inside a
+   rank-guarded branch strands the followers (``leader-only-barrier``).
+   The rendezvous funnel methods themselves (rules.BARRIER_FUNNEL_METHODS)
+   implement the protocol and are exempt.
+
+3. **Incarnation contract** (``torn-state-hazard``, never baselineable).
+   Control-plane state in rules.PERSISTENCE_STATE_MODULES must install
+   through an atomic-rename funnel (rules.PERSISTENCE_CALLS — the
+   tmp+fsync+os.replace shapes in checkpoint/io.py). A bare
+   ``open(path, "w")`` write or ``shutil.copyfile`` in a function that
+   never ``os.replace``s, or a two-file update mixing distinct persistence
+   funnels without a single authoritative install site, leaves a window
+   where a SIGKILL tears the recovered state. The static census of
+   persistence-funnel call sites this pass produces is exactly what the
+   runtime half (mck.py, ``python -m hydragnn_tpu.analysis modelcheck``)
+   uses to auto-discover crash-injection points — the checker never
+   hand-picks a kill site.
+
+Suppressions use the shared grammar (``# graftproto: disable=rule(reason)``,
+interchangeable with ``graftlint:``/``graftrace:``). ``collective-divergence``
+and ``torn-state-hazard`` join the never-baselineable set (baseline.py):
+a grandfathered rank-divergent collective deadlocks the first real
+multi-host mesh; a grandfathered torn-state window corrupts every crash
+recovery after it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import rules as R
+from .concurrency import Tracer
+from .graftlint import (
+    _FUNC_NODES,
+    FuncInfo,
+    ModuleInfo,
+    Report,
+    Violation,
+    _dotted,
+)
+
+# Thread roots named "<prefix>-<digits>" are members of one lockstep segment
+# (the convention run_workers/test fixtures use for per-rank threads).
+_SEGMENT_MEMBER_RE = re.compile(r"^(?P<prefix>.+)-(?P<idx>\d+)$")
+
+# open() modes that WRITE (a torn-state candidate in persistence modules).
+_WRITE_MODES = ("w", "a", "x")
+
+# shutil entry points that copy/move bytes non-atomically.
+_COPY_CALLS = frozenset(
+    {"shutil.copyfile", "shutil.copy", "shutil.copy2", "shutil.move"}
+)
+
+_ATOMIC_INSTALL_CALLS = frozenset({"os.replace", "os.rename"})
+
+
+@dataclass
+class PersistencePoint:
+    """One static persistence-funnel call site — the model checker's
+    injection-point census entry."""
+
+    path: str
+    qualname: str
+    callee: str
+    line: int
+
+    @property
+    def site_id(self) -> str:
+        return f"{self.path}::{self.qualname}::{self.callee}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "qualname": self.qualname,
+            "callee": self.callee,
+            "line": self.line,
+            "site_id": self.site_id,
+        }
+
+
+@dataclass
+class ProtoReport(Report):
+    """graftproto run result: graftlint's Report plus the lockstep topology
+    and the persistence-point census the runtime half consumes."""
+
+    lockstep_segments: Dict[str, List[str]] = field(default_factory=dict)
+    barrier_sequences: Dict[str, List[str]] = field(default_factory=dict)
+    persistence_points: List[Dict[str, Any]] = field(default_factory=list)
+    collective_functions: List[str] = field(default_factory=list)
+
+
+class ProtoAnalyzer(Tracer):
+    """The graftproto pass. Reuses the linter's parsing/suppressions, the
+    tracer's root discovery, call resolution, and lock model; adds the
+    collective/barrier/persistence rule families."""
+
+    def __init__(self, paths: Sequence[str], root: Optional[str] = None):
+        super().__init__(paths, root=root)
+        # segment name -> member FuncInfos (>= 2 members => sequence check)
+        self.segments: Dict[str, List[FuncInfo]] = {}
+        # root names whose functions execute as every rank of a segment
+        self.lockstep_roots: Set[str] = set()
+        self._fn_barrier_seq: Dict[int, Tuple[str, ...]] = {}
+        self._fn_collective_seq: Dict[int, Tuple[str, ...]] = {}
+        self.persistence_points: List[PersistencePoint] = []
+
+    # ------------------------------------------------------------------ run
+    def run_proto(self, check_suppressions: bool = True) -> ProtoReport:
+        report = ProtoReport()
+        self.load(report)
+        self._index_classes()
+        self._collect_guard_comments()
+        self._infer_attr_types()
+        self._mark_traced_roots()
+        self._propagate_traced()
+        self._discover_roots()
+        self._discover_lockstep_roots()
+        self._propagate_roots()
+        self._build_lock_graph(report)
+        self._collect_segments()
+        self._check_collective_lockstep(report)
+        self._check_barrier_protocol(report)
+        self._check_incarnation_contract(report)
+        if check_suppressions:
+            self._check_proto_suppressions(report)
+        report.lockstep_segments = {
+            name: sorted(f.qualname for f in fns)
+            for name, fns in sorted(self.segments.items())
+        }
+        report.barrier_sequences = {
+            name: [
+                list(self._barrier_seq(f.module, f)) for f in fns
+            ][0] if fns else []
+            for name, fns in sorted(self.segments.items())
+        }
+        report.persistence_points = [
+            p.as_dict()
+            for p in sorted(
+                self.persistence_points, key=lambda p: (p.path, p.line)
+            )
+        ]
+        report.collective_functions = sorted(
+            {
+                fn.qualname
+                for mod in self.modules
+                for fn in mod.functions
+                if self._collective_seq(mod, fn)
+            }
+        )
+        report.violations.sort(key=lambda v: (v.path, v.line, v.col))
+        report.suppressed.sort(key=lambda v: (v.path, v.line, v.col))
+        return report
+
+    # -------------------------------------------------------- lockstep roots
+    def _discover_lockstep_roots(self) -> None:
+        """``run_workers(world, fn)`` executes ``fn`` as EVERY rank of one
+        lockstep segment on f-string-named threads static analysis cannot
+        read — rules.LOCKSTEP_CALLABLE_BINDINGS names the binding the way
+        THREAD_CALLABLE_BINDINGS names the pipeline threads."""
+        for mod in self.modules:
+            for fn in mod.functions:
+                for dotted, call in fn.calls:
+                    tail = dotted.split(".")[-1]
+                    binding = R.LOCKSTEP_CALLABLE_BINDINGS.get(tail)
+                    if not binding:
+                        continue
+                    bound: List[ast.AST] = []
+                    for i, arg in enumerate(call.args):
+                        if i in binding:
+                            bound.append(arg)
+                    for kw in call.keywords:
+                        if kw.arg in binding:
+                            bound.append(kw.value)
+                    for arg in bound:
+                        tfn = self._resolve_callable_arg(mod, fn, arg)
+                        if tfn is None:
+                            continue
+                        base = binding.get("fn") or next(iter(binding.values()))
+                        # Segment identity is PER CALL SITE: two different
+                        # run_workers() invocations are two independent
+                        # rendezvous rounds, not peers of one segment.
+                        seg = f"{base}@{fn.qualname}"
+                        self._add_root(seg, tfn, mod.relpath)
+                        self.lockstep_roots.add(seg)
+                        members = self.segments.setdefault(seg, [])
+                        if tfn not in members:
+                            members.append(tfn)
+
+    def _collect_segments(self) -> None:
+        """Group constant-named thread roots ``<prefix>-<digits>`` into
+        lockstep segments: per-rank threads spawned with literal names are
+        peers of one rendezvous round and must trace the same barrier
+        sequence."""
+        by_qual: Dict[Tuple[str, str], FuncInfo] = {}
+        for mod in self.modules:
+            for fn in mod.functions:
+                by_qual[(mod.relpath, fn.qualname)] = fn
+        groups: Dict[str, List[Tuple[str, FuncInfo]]] = {}
+        for root, wheres in self.roots_found.items():
+            m = _SEGMENT_MEMBER_RE.match(root)
+            if not m:
+                continue
+            for where in wheres:
+                relpath, _, qual = where.partition("::")
+                fn = by_qual.get((relpath, qual))
+                if fn is not None:
+                    groups.setdefault(m.group("prefix"), []).append(
+                        (root, fn)
+                    )
+        for prefix, members in groups.items():
+            fns: List[FuncInfo] = []
+            for root, fn in members:
+                if fn not in fns:
+                    fns.append(fn)
+            if len(members) >= 2:
+                seg = self.segments.setdefault(prefix, [])
+                for fn in fns:
+                    if fn not in seg:
+                        seg.append(fn)
+                self.lockstep_roots.update(r for r, _ in members)
+
+    def _is_lockstep_fn(self, fn: FuncInfo) -> bool:
+        return bool(fn.roots & self.lockstep_roots)
+
+    # ---------------------------------------------------- ordered traversal
+    @classmethod
+    def _ordered_own(cls, node: ast.AST):
+        """Depth-first, source-order traversal that does not descend into
+        nested function definitions (their sequences are accounted through
+        the call graph when they are actually called)."""
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if not isinstance(child, _FUNC_NODES):
+                yield from cls._ordered_own(child)
+
+    # --------------------------------------------------- collective lockstep
+    @staticmethod
+    def _collective_tail(canon: str, dotted: str) -> Optional[str]:
+        """The collective op name if this dotted call is one (``lax.psum``,
+        ``jax.lax.ppermute``, bare ``psum`` through a from-import)."""
+        for probe in (canon, dotted):
+            if not probe:
+                continue
+            parts = probe.split(".")
+            if parts[-1] in R.COLLECTIVE_CALLS:
+                prefix = parts[:-1]
+                if not prefix or prefix[-1] in ("lax", "jax") or (
+                    len(prefix) >= 2 and prefix[-2:] == ["jax", "lax"]
+                ):
+                    return parts[-1]
+        return None
+
+    @staticmethod
+    def _call_axis_name(call: ast.Call) -> str:
+        """The axis_name literal, when visible — part of the sequence
+        element so ``psum('data')`` != ``psum('graph')``."""
+        cands: List[ast.AST] = list(call.args[1:2])
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                cands.append(kw.value)
+        for c in cands:
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                return c.value
+        return "?"
+
+    def _collective_seq(
+        self,
+        mod: ModuleInfo,
+        fn: FuncInfo,
+        _stack: Optional[Set[int]] = None,
+    ) -> Tuple[str, ...]:
+        """Transitive source-order collective sequence of ``fn`` (cycle
+        guarded, memoized): its own collective calls plus those of every
+        statically-resolvable callee."""
+        cached = self._fn_collective_seq.get(id(fn))
+        if cached is not None:
+            return cached
+        stack = _stack or set()
+        if id(fn) in stack:
+            return ()
+        stack = stack | {id(fn)}
+        seq = tuple(self._seq_of_body(mod, fn, fn.node, stack, "collective"))
+        if _stack is None:
+            self._fn_collective_seq[id(fn)] = seq
+        return seq
+
+    def _barrier_seq(
+        self,
+        mod: ModuleInfo,
+        fn: FuncInfo,
+        _stack: Optional[Set[int]] = None,
+    ) -> Tuple[str, ...]:
+        """Transitive source-order rendezvous-round sequence of ``fn``:
+        named barriers plus tagged exchange/broadcast/allgather rounds."""
+        cached = self._fn_barrier_seq.get(id(fn))
+        if cached is not None:
+            return cached
+        stack = _stack or set()
+        if id(fn) in stack:
+            return ()
+        stack = stack | {id(fn)}
+        seq = tuple(self._seq_of_body(mod, fn, fn.node, stack, "barrier"))
+        if _stack is None:
+            self._fn_barrier_seq[id(fn)] = seq
+        return seq
+
+    _BARRIER_TAILS = ("barrier", "exchange", "broadcast", "allgather")
+
+    def _is_funnel_fn(self, fn: FuncInfo) -> bool:
+        return (fn.class_name, fn.name) in R.BARRIER_FUNNEL_METHODS
+
+    @classmethod
+    def _barrier_site_name(cls, call: ast.Call, tail: str) -> Optional[str]:
+        """The sequence element for a rendezvous-round call site, or None
+        when the call is not one (an attribute named ``exchange`` on an
+        arbitrary object without a tag is ignored — only ``barrier`` is
+        unambiguous without one)."""
+        name = None
+        kwname = "name" if tail == "barrier" else "tag"
+        for kw in call.keywords:
+            if kw.arg == kwname and isinstance(kw.value, ast.Constant):
+                name = str(kw.value.value)
+        if name is None and tail == "barrier":
+            for arg in call.args:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    name = arg.value
+                    break
+            if name is None:
+                name = "barrier" if not call.args else "<dynamic>"
+        if name is None and tail != "barrier":
+            return None
+        return f"{tail}:{name}"
+
+    def _seq_of_body(
+        self,
+        mod: ModuleInfo,
+        fn: FuncInfo,
+        node: ast.AST,
+        stack: Set[int],
+        kind: str,
+    ) -> List[str]:
+        out: List[str] = []
+        for sub in self._ordered_own(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func) or ""
+            if kind == "collective":
+                canon = mod.canonical(dotted) or ""
+                tail = self._collective_tail(canon, dotted)
+                if tail:
+                    out.append(f"{tail}:{self._call_axis_name(sub)}")
+                    continue
+            else:
+                if isinstance(sub.func, ast.Attribute) and (
+                    sub.func.attr in self._BARRIER_TAILS
+                ):
+                    el = self._barrier_site_name(sub, sub.func.attr)
+                    if el is not None:
+                        out.append(el)
+                        continue
+            if dotted:
+                target = self._resolve_call_ext(mod, fn, dotted)
+                if target is not None and not self._is_funnel_fn(target):
+                    if kind == "collective":
+                        out.extend(
+                            self._collective_seq(target.module, target, stack)
+                        )
+                    else:
+                        out.extend(
+                            self._barrier_seq(target.module, target, stack)
+                        )
+        return out
+
+    @staticmethod
+    def _test_names(test: ast.AST) -> Set[str]:
+        """Plain names and attribute tails referenced by a branch
+        condition."""
+        names: Set[str] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                names.add(node.attr)
+        return names
+
+    @staticmethod
+    def _fn_params(fn: FuncInfo) -> Set[str]:
+        args = getattr(fn.node, "args", None)
+        if args is None:
+            return set()
+        out = {a.arg for a in list(args.args) + list(args.kwonlyargs)}
+        if args.vararg:
+            out.add(args.vararg.arg)
+        if args.kwarg:
+            out.add(args.kwarg.arg)
+        return out
+
+    @staticmethod
+    def _arm_terminates(body: List[ast.stmt]) -> bool:
+        return any(
+            isinstance(s, (ast.Return, ast.Raise, ast.Break, ast.Continue))
+            for s in body
+        )
+
+    def _check_collective_lockstep(self, report: ProtoReport) -> None:
+        for mod in self.modules:
+            for fn in mod.functions:
+                traced = fn.traced
+                lockstep = self._is_lockstep_fn(fn)
+                if not traced and not lockstep:
+                    continue
+                if self._is_funnel_fn(fn):
+                    continue
+                params = self._fn_params(fn)
+                for node in self._ordered_own(fn.node):
+                    if isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                        self._check_branch(
+                            report, mod, fn, node, traced, params
+                        )
+
+    def _check_branch(
+        self,
+        report: ProtoReport,
+        mod: ModuleInfo,
+        fn: FuncInfo,
+        node: ast.AST,
+        traced: bool,
+        params: Set[str],
+    ) -> None:
+        names = self._test_names(node.test)  # type: ignore[attr-defined]
+        rank_guarded = bool(names & R.RANK_GUARD_NAMES)
+        if traced and rank_guarded:
+            self._emit(
+                report,
+                mod,
+                "collective-divergence",
+                node,
+                "branch conditioned on rank identity "
+                f"({sorted(names & R.RANK_GUARD_NAMES)}) inside traced "
+                "code — ranks trace different programs and the mesh's "
+                "collective sequence diverges",
+                fn.qualname,
+            )
+            return
+        if traced:
+            # A Python branch that EXECUTES inside traced code is by
+            # construction on a trace-time-static value (branching on a
+            # tracer raises TracerBoolConversionError at trace time, which
+            # jit itself catches), and a non-rank static — axis_name, a mode
+            # flag, a ladder rung — is identical on every rank of the single
+            # program. Only rank-derived conditions (handled above) can make
+            # the traced collective sequence diverge.
+            return
+        if isinstance(node, ast.While):
+            return
+        # Path-dependent collective sequence: the arms trace different
+        # collectives and the condition is NOT a trace-time constant
+        # (it depends on the function's own parameters or rank names;
+        # closure/global config names are the same on every rank).
+        if isinstance(node, ast.IfExp):
+            arm_a = self._seq_of_expr(mod, fn, node.body)
+            arm_b = self._seq_of_expr(mod, fn, node.orelse)
+            diverges = arm_a != arm_b
+        else:
+            arm_a = tuple(
+                s
+                for stmt in node.body
+                for s in self._seq_of_body(
+                    mod, fn, stmt, {id(fn)}, "collective"
+                )
+            )
+            arm_b = tuple(
+                s
+                for stmt in node.orelse
+                for s in self._seq_of_body(
+                    mod, fn, stmt, {id(fn)}, "collective"
+                )
+            )
+            diverges = arm_a != arm_b
+            if not diverges and (
+                self._arm_terminates(node.body)
+                != self._arm_terminates(node.orelse or [])
+            ):
+                # An early return/raise in one arm makes everything AFTER
+                # the branch part of the other path only.
+                rest = self._collectives_after(mod, fn, node)
+                diverges = bool(rest)
+        if not diverges:
+            return
+        dependent = bool(names & params) or rank_guarded
+        if not dependent:
+            return
+        scope = "traced" if traced else "lockstep-segment"
+        self._emit(
+            report,
+            mod,
+            "collective-divergence",
+            node,
+            f"branch arms trace different collective sequences "
+            f"({list(arm_a) or 'none'} vs {list(arm_b) or 'none'}) and the "
+            f"condition depends on {sorted(names & (params | R.RANK_GUARD_NAMES))} "
+            f"— a non-constant in {scope} code makes the mesh's collective "
+            "sequence path-dependent",
+            fn.qualname,
+        )
+
+    def _seq_of_expr(
+        self, mod: ModuleInfo, fn: FuncInfo, expr: ast.AST
+    ) -> Tuple[str, ...]:
+        return tuple(self._seq_of_body(mod, fn, expr, {id(fn)}, "collective"))
+
+    def _collectives_after(
+        self, mod: ModuleInfo, fn: FuncInfo, branch: ast.AST
+    ) -> Tuple[str, ...]:
+        """Collective sequence of the statements following ``branch`` in its
+        enclosing body (what an early-returning arm skips)."""
+        out: List[str] = []
+
+        def scan(node: ast.AST) -> bool:
+            for name in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, name, None)
+                if not isinstance(stmts, list):
+                    continue
+                for i, stmt in enumerate(stmts):
+                    if stmt is branch:
+                        for later in stmts[i + 1:]:
+                            out.extend(
+                                self._seq_of_body(
+                                    mod, fn, later, {id(fn)}, "collective"
+                                )
+                            )
+                        return True
+                    if not isinstance(stmt, _FUNC_NODES) and scan(stmt):
+                        return True
+            return False
+
+        scan(fn.node)
+        return tuple(out)
+
+    # ------------------------------------------------------ barrier protocol
+    def _check_barrier_protocol(self, report: ProtoReport) -> None:
+        self._check_barrier_divergence(report)
+        for mod in self.modules:
+            for fn in mod.functions:
+                if self._is_funnel_fn(fn):
+                    continue
+                self._check_leader_only(report, mod, fn)
+                self._check_barrier_under_lock(report, mod, fn)
+
+    def _check_barrier_divergence(self, report: ProtoReport) -> None:
+        for name, members in sorted(self.segments.items()):
+            if len(members) < 2:
+                continue
+            seqs = [
+                (fn, self._barrier_seq(fn.module, fn)) for fn in members
+            ]
+            base_fn, base = seqs[0]
+            for fn, seq in seqs[1:]:
+                if seq != base:
+                    self._emit(
+                        report,
+                        fn.module,
+                        "barrier-divergence",
+                        fn.node,
+                        f"lockstep segment {name!r}: {fn.qualname} reaches "
+                        f"barrier sequence {list(seq)} but peer "
+                        f"{base_fn.qualname} reaches {list(base)} — the "
+                        "rendezvous round can never complete",
+                        fn.qualname,
+                    )
+
+    def _barrier_calls_under(
+        self, mod: ModuleInfo, fn: FuncInfo, node: ast.AST
+    ) -> List[Tuple[ast.Call, str]]:
+        """(call node, element) pairs for every rendezvous round reachable
+        from ``node``'s subtree — direct sites plus through-calls."""
+        out: List[Tuple[ast.Call, str]] = []
+        nodes = [node] if isinstance(node, ast.Call) else []
+        nodes += [
+            n for n in self._ordered_own(node) if isinstance(n, ast.Call)
+        ]
+        for call in nodes:
+            if isinstance(call.func, ast.Attribute) and (
+                call.func.attr in self._BARRIER_TAILS
+            ):
+                el = self._barrier_site_name(call, call.func.attr)
+                if el is not None:
+                    out.append((call, el))
+                    continue
+            dotted = _dotted(call.func) or ""
+            if dotted:
+                target = self._resolve_call_ext(mod, fn, dotted)
+                if target is not None and not self._is_funnel_fn(target):
+                    seq = self._barrier_seq(target.module, target)
+                    if seq:
+                        out.append(
+                            (call, f"{dotted}() -> {seq[0]}")
+                        )
+        return out
+
+    def _check_leader_only(
+        self, report: ProtoReport, mod: ModuleInfo, fn: FuncInfo
+    ) -> None:
+        for node in self._ordered_own(fn.node):
+            if not isinstance(node, ast.If):
+                continue
+            names = self._test_names(node.test)
+            guards = names & R.RANK_GUARD_NAMES
+            if not guards:
+                continue
+            for arm in (node.body, node.orelse):
+                for stmt in arm:
+                    for call, el in self._barrier_calls_under(
+                        mod, fn, stmt
+                    ):
+                        self._emit(
+                            report,
+                            mod,
+                            "leader-only-barrier",
+                            call,
+                            f"rendezvous round {el!r} inside a branch "
+                            f"guarded by rank identity ({sorted(guards)}) "
+                            "— the other ranks never arrive and the round "
+                            "blocks until timeout",
+                            fn.qualname,
+                        )
+
+    def _check_barrier_under_lock(
+        self, report: ProtoReport, mod: ModuleInfo, fn: FuncInfo
+    ) -> None:
+        held_map = self._held_locks_map(mod, fn)
+        lock_roots = self._lock_acquirer_roots()
+        for call, el in self._barrier_calls_under(mod, fn, fn.node):
+            held = held_map.get(id(call), frozenset())
+            if not held:
+                continue
+            for lock in sorted(held):
+                other = lock_roots.get(lock, set()) - fn.roots
+                if other:
+                    self._emit(
+                        report,
+                        mod,
+                        "barrier-under-lock",
+                        call,
+                        f"rendezvous round {el!r} while holding "
+                        f"{lock.split('::')[-1]}, which thread root(s) "
+                        f"{sorted(other)} also acquire — peers blocked on "
+                        "the lock never reach the barrier (distributed "
+                        "deadlock)",
+                        fn.qualname,
+                    )
+                    break
+
+    def _lock_acquirer_roots(self) -> Dict[str, Set[str]]:
+        cached = getattr(self, "_lock_roots_cache", None)
+        if cached is not None:
+            return cached
+        out: Dict[str, Set[str]] = {}
+        for mod in self.modules:
+            for fn in mod.functions:
+                for lock in self._fn_acquires.get(id(fn), ()):
+                    out.setdefault(lock, set()).update(fn.roots)
+        self._lock_roots_cache = out
+        return out
+
+    # --------------------------------------------------- incarnation contract
+    def _check_incarnation_contract(self, report: ProtoReport) -> None:
+        for mod in self.modules:
+            in_scope = any(
+                mod.relpath.endswith(m) for m in R.PERSISTENCE_STATE_MODULES
+            )
+            for fn in mod.functions:
+                if in_scope:
+                    self._census_fn(mod, fn)
+                    if fn.name not in R.PERSISTENCE_FUNNEL_FUNCTIONS:
+                        self._check_torn_state(report, mod, fn)
+
+    def _census_fn(self, mod: ModuleInfo, fn: FuncInfo) -> None:
+        for dotted, call in fn.calls:
+            tail = dotted.split(".")[-1]
+            if tail in R.PERSISTENCE_CALLS:
+                self.persistence_points.append(
+                    PersistencePoint(
+                        path=mod.relpath,
+                        qualname=fn.qualname,
+                        callee=tail,
+                        line=getattr(call, "lineno", fn.line),
+                    )
+                )
+
+    def _check_torn_state(
+        self, report: ProtoReport, mod: ModuleInfo, fn: FuncInfo
+    ) -> None:
+        has_atomic_install = False
+        raw_writes: List[Tuple[ast.Call, str]] = []
+        funnel_calls: List[Tuple[ast.Call, str, str]] = []
+        for node in self._ordered_own(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            canon = mod.canonical(dotted) or dotted
+            if canon in _ATOMIC_INSTALL_CALLS:
+                has_atomic_install = True
+                continue
+            if canon in _COPY_CALLS:
+                raw_writes.append((node, canon))
+                continue
+            if canon == "open" or dotted == "open":
+                mode = None
+                if len(node.args) >= 2 and isinstance(
+                    node.args[1], ast.Constant
+                ):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        mode = kw.value.value
+                if isinstance(mode, str) and any(
+                    m in mode for m in _WRITE_MODES
+                ):
+                    raw_writes.append((node, f"open(..., {mode!r})"))
+                continue
+            tail = dotted.split(".")[-1]
+            if tail in R.PERSISTENCE_CALLS:
+                target = node.args[0] if node.args else None
+                target_desc = (
+                    _dotted(target)
+                    or (
+                        repr(target.value)
+                        if isinstance(target, ast.Constant)
+                        else ast.dump(target)[:60]
+                    )
+                    if target is not None
+                    else "?"
+                )
+                funnel_calls.append((node, tail, target_desc))
+        if not has_atomic_install:
+            for node, desc in raw_writes:
+                self._emit(
+                    report,
+                    mod,
+                    "torn-state-hazard",
+                    node,
+                    f"{desc} writes control-plane state without an atomic "
+                    "rename — a crash mid-write leaves a torn file the "
+                    "next incarnation reads; route through "
+                    "checkpoint.io's tmp+fsync+os.replace funnels",
+                    fn.qualname,
+                )
+        distinct = {(callee, tgt) for _, callee, tgt in funnel_calls}
+        if len(distinct) >= 2:
+            callees = {c for c, _ in distinct}
+            if len(callees) >= 2 or len({t for _, t in distinct}) >= 2:
+                node = funnel_calls[-1][0]
+                self._emit(
+                    report,
+                    mod,
+                    "torn-state-hazard",
+                    node,
+                    "two-file state update in one function "
+                    f"({sorted('%s(%s)' % d for d in distinct)}) without a "
+                    "single authoritative install site — a crash between "
+                    "the installs tears the pair; make one file the "
+                    "authority (installed last) or merge the update",
+                    fn.qualname,
+                )
+
+    # ------------------------------------------------------ suppression meta
+    def _check_proto_suppressions(self, report: ProtoReport) -> None:
+        """Reason-less suppressions for the PROTO rules only (the lint pass
+        owns the check for its rules; the combined CLI run disables this
+        half to avoid double reports)."""
+        for mod in self.modules:
+            for line, (rule, reason) in sorted(mod.suppressions.items()):
+                if rule not in R.PROTO_RULES:
+                    continue
+                if not reason:
+                    report.violations.append(
+                        Violation(
+                            rule="suppression-without-reason",
+                            path=mod.relpath,
+                            line=line,
+                            col=0,
+                            message=(
+                                f"disable={rule} needs a justification: "
+                                f"# graftproto: disable={rule}(why this is "
+                                "safe)"
+                            ),
+                            qualname="<module>",
+                        )
+                    )
+
+
+def proto_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    check_suppressions: bool = True,
+) -> ProtoReport:
+    """Run graftproto over files/directories; returns the ProtoReport
+    (violations exclude properly-suppressed ones, which land in
+    ``report.suppressed``)."""
+    return ProtoAnalyzer(paths, root=root).run_proto(
+        check_suppressions=check_suppressions
+    )

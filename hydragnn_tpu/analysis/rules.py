@@ -130,6 +130,41 @@ RULES = {
             "JAX dispatch from a thread root outside the sanctioned "
             "DeviceFeed transfer / serve dispatch paths",
         ),
+        # ------------------------------------------------ graftproto (protocol)
+        Rule(
+            "collective-divergence",
+            "rank-dependent branch, or a branch whose arms trace different "
+            "collective sequences, inside compiled/lockstep code — ranks "
+            "would issue mismatched collectives and the mesh deadlocks",
+        ),
+        Rule(
+            "barrier-divergence",
+            "members of one lockstep segment reach different named-barrier "
+            "sequences — the rendezvous round can never complete",
+        ),
+        Rule(
+            "barrier-under-lock",
+            "rendezvous barrier reached while holding a lock another thread "
+            "root acquires — a distributed convoy/deadlock shape",
+        ),
+        Rule(
+            "leader-only-barrier",
+            "rendezvous barrier inside a rank-guarded branch — followers "
+            "never arrive and the leader blocks until the round times out",
+        ),
+        Rule(
+            "torn-state-hazard",
+            "persistence write in control-plane state code that is not "
+            "atomic-rename-shaped (or a multi-file update without a single "
+            "authoritative install) — a crash tears the recovered state",
+        ),
+        # ------------------------------------------------ graftlint additions
+        Rule(
+            "pickle-load-outside-compat",
+            "pickle.load/pickle.loads/torch.load outside the sanctioned "
+            "v1-compat shims — the raw-pickle read path was deprecated in "
+            "PR 16 (GSHD convert CLI); new call sites are regressions",
+        ),
     )
 }
 
@@ -144,6 +179,19 @@ CONCURRENCY_RULES = frozenset(
         "blocking-queue-in-lock",
         "fork-after-threads",
         "jax-dispatch-off-main",
+    }
+)
+
+# Rule ids owned by the graftproto protocol pass (analysis/proto.py). The
+# three passes (lint / trace / proto) partition RULES so their baseline
+# updates never clobber each other's keys (__main__.py preserve logic).
+PROTO_RULES = frozenset(
+    {
+        "collective-divergence",
+        "barrier-divergence",
+        "barrier-under-lock",
+        "leader-only-barrier",
+        "torn-state-hazard",
     }
 )
 
@@ -346,4 +394,108 @@ BLOCKING_METHODS_BY_TYPE = {
 FORK_CALLS = frozenset({"os.fork", "os.forkpty", "pty.fork"})
 MP_PROCESS_CALLS = frozenset(
     {"multiprocessing.Process", "multiprocessing.Pool"}
+)
+
+
+# ----------------------------------------------------- graftproto framework map
+# Collective call name tails: a call whose dotted tail is one of these (with
+# or without the jax.lax/lax prefix) participates in the mesh's lockstep
+# collective sequence. Ranks must trace IDENTICAL sequences or the XLA
+# program deadlocks on a real multi-host mesh.
+COLLECTIVE_CALLS = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "ppermute",
+        "pshuffle",
+        "all_gather",
+        "all_to_all",
+        "axis_index",
+    }
+)
+# Names whose truthiness encodes rank identity: branching on one inside
+# traced or lockstep code makes different ranks take different paths.
+RANK_GUARD_NAMES = frozenset(
+    {
+        "rank",
+        "shard_rank",
+        "worker_rank",
+        "process_index",
+        "host_id",
+        "is_leader",
+        "leader",
+    }
+)
+
+# Framework callables whose callable ARGUMENT runs as every member of a
+# lockstep segment (run_workers spawns one thread per rank, all executing the
+# bound fn with f-string thread names static analysis cannot read): the
+# runs-on-thread analog of THREAD_CALLABLE_BINDINGS for the mesh harness.
+# position/keyword -> the lockstep segment name the bound callable joins.
+LOCKSTEP_CALLABLE_BINDINGS = {
+    "run_workers": {1: "mesh-worker", "fn": "mesh-worker"},
+}
+
+# Rendezvous-barrier funnel methods: Class.method pairs that IMPLEMENT the
+# barrier protocol (they are the barrier, not users of it) — their bodies are
+# exempt from the barrier-protocol rules.
+BARRIER_FUNNEL_METHODS = frozenset(
+    {
+        ("LoopbackRendezvous", "barrier"),
+        ("ProxyRendezvous", "barrier"),
+        ("LoopbackWorker", "barrier"),
+        ("LoopbackRendezvous", "exchange"),
+        ("LoopbackRendezvous", "broadcast"),
+        ("ProxyRendezvous", "exchange"),
+        ("ProxyRendezvous", "broadcast"),
+        ("ProxyRendezvous", "allgather"),
+    }
+)
+
+# Atomic persistence funnels: call tails that ARE the atomic-rename install
+# (checkpoint/io.py's tmp+fsync+os.replace shapes). Control-plane state must
+# flow through one of these; a bare open(path,"w")/shutil copy in a
+# PERSISTENCE_STATE_MODULES function that never os.replace()s is a
+# torn-state-hazard.
+PERSISTENCE_CALLS = frozenset(
+    {
+        "atomic_write_json",
+        "write_checkpoint_blob",
+        "atomic_copy_file",
+    }
+)
+# Module-path substrings whose functions hold crash-recovered control-plane
+# state (the incarnation contract's scope). Telemetry/bench/dataset writers
+# outside these paths are free to stream to open files.
+PERSISTENCE_STATE_MODULES = (
+    "checkpoint/io.py",
+    "checkpoint/async_writer.py",
+    "lifecycle/registry.py",
+    "lifecycle/manager.py",
+    "flywheel/loop.py",
+    "parallel/elastic.py",
+)
+# Function names inside PERSISTENCE_STATE_MODULES that IMPLEMENT the atomic
+# funnels (the open(tmp,"wb") + os.replace inside them is the mechanism, not
+# a hazard).
+PERSISTENCE_FUNNEL_FUNCTIONS = frozenset(
+    {
+        "atomic_write_json",
+        "write_checkpoint_blob",
+        "atomic_copy_file",
+        "_unique_tmp",
+    }
+)
+
+# Raw-deserialization entry points (pickle-load-outside-compat): the GSHD
+# digest-verified containers replaced these in PR 16; surviving call sites
+# are sanctioned v1-compat shims and carry reasoned suppressions.
+PICKLE_LOAD_CALLS = frozenset(
+    {
+        "pickle.load",
+        "pickle.loads",
+        "torch.load",
+    }
 )

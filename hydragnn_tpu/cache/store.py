@@ -489,6 +489,7 @@ def deserialize_compiled(sections: Dict[str, bytes]) -> Any:
     from jax.experimental import serialize_executable as se
 
     try:
+        # graftlint: disable=pickle-load-outside-compat(pytree defs inside a GSHD cache container whose digest was verified before this call — no untrusted bytes reach the unpickler)
         in_tree, out_tree = pickle.loads(sections["trees"])
         return se.deserialize_and_load(
             sections["executable"], in_tree, out_tree

@@ -76,6 +76,22 @@ def atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
     os.replace(tmp, path)
 
 
+def atomic_copy_file(src: str, dst: str) -> None:
+    """Atomic byte-copy install (unique tmp + fsync + rename): the forensic
+    sibling of :func:`atomic_write_json` for copying an existing artifact
+    (e.g. a rejected candidate into quarantine). A crash mid-copy leaves
+    only a writer-owned ``.tmp``, never a torn half-copy at ``dst`` that a
+    later reader would mistake for the real bytes."""
+    import shutil
+
+    tmp = _unique_tmp(dst)
+    with open(src, "rb") as fsrc, open(tmp, "wb") as f:
+        shutil.copyfileobj(fsrc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
+
+
 def cleanup_stale_checkpoint_tmp(run_dir: str) -> List[str]:
     """Remove ``*.tmp`` files a crash left behind mid-``os.replace``. Scoped
     to RUN STARTUP only (run_training bootstrap, supervisor entry) — at
@@ -354,6 +370,7 @@ def payload_from_blob(blob: bytes, path_name: str = "<bytes>") -> Tuple[int, Dic
     # v1 legacy pickle. Any decode failure — truncation, a flipped byte in
     # the pickle stream, a non-dict payload — is corruption.
     try:
+        # graftlint: disable=pickle-load-outside-compat(THE sanctioned v1-compat shim: the one place legacy headerless checkpoints may be unpickled, behind _warn_v1_once)
         payload = pickle.loads(blob)
     except Exception as e:
         raise CheckpointCorruptError(

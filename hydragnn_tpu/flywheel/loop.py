@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import os
 import queue
-import shutil
 import threading
 import time
 from dataclasses import asdict, dataclass
@@ -424,11 +423,13 @@ class Flywheel:
         """Copy the rejected candidate's bytes aside (best-effort: the
         evidence should survive the trainer overwriting ``<name>.pk`` with
         its next save, but a vanished file must not mask the rejection)."""
+        from ..checkpoint import io as ckpt_io
+
         qdir = os.path.join(self.run_dir, self.config.quarantine_dir)
         dst = os.path.join(qdir, f"{mv.short}.pk")
         try:
             os.makedirs(qdir, exist_ok=True)
-            shutil.copyfile(mv.path, dst)
+            ckpt_io.atomic_copy_file(mv.path, dst)
         except OSError:
             return None
         return dst

@@ -48,6 +48,11 @@ from typing import Any, Callable, List, Optional, Sequence
 from ..analysis import tsan
 
 _BARRIER_TIMEOUT_S = 300.0
+# One-way mailbox post (heartbeats, membership announcements) read/write
+# deadline. Named so the static config gate (contracts.bad-elastic-timing)
+# can check Training.elastic.heartbeat_s against the SAME number the wire
+# path actually uses.
+_POST_TIMEOUT_S = 10.0
 
 
 class LoopbackError(RuntimeError):
@@ -596,7 +601,7 @@ class ProxyRendezvous:
     @staticmethod
     def post(
         address: str, tag: str, rank: int, payload: Any,
-        timeout_s: float = 10.0,
+        timeout_s: float = _POST_TIMEOUT_S,
         connect_retries: int = 2,
     ) -> None:
         """One-way mailbox post (heartbeats, membership announcements):

@@ -50,6 +50,7 @@ def load_history(output_dir: str) -> dict:
             stacklevel=2,
         )
     with open(pkl_path, "rb") as f:
+        # graftlint: disable=pickle-load-outside-compat(v1 history sidecar shim — deprecated read path, DeprecationWarning issued above)
         return pickle.load(f)
 
 

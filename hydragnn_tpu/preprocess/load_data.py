@@ -213,9 +213,9 @@ def total_to_train_val_test_pkls(config: Dict):
 
     warn_pickle_corpus_once()
     with open(file_dir, "rb") as f:
-        minmax_node_feature = pickle.load(f)
-        minmax_graph_feature = pickle.load(f)
-        dataset_total = pickle.load(f)
+        minmax_node_feature = pickle.load(f)  # graftlint: disable=pickle-load-outside-compat(legacy HydraGNN corpus shim gated behind warn_pickle_corpus_once — the GSHD shard path is the supported reader)
+        minmax_graph_feature = pickle.load(f)  # graftlint: disable=pickle-load-outside-compat(legacy corpus shim, see above)
+        dataset_total = pickle.load(f)  # graftlint: disable=pickle-load-outside-compat(legacy corpus shim, see above)
 
     trainset, valset, testset = split_dataset(
         dataset=dataset_total,

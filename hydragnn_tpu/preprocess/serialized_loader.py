@@ -79,9 +79,9 @@ class SerializedDataLoader:
     def load_serialized_data(self, dataset_path: str) -> List[GraphSample]:
         warn_pickle_corpus_once()
         with open(dataset_path, "rb") as f:
-            _ = pickle.load(f)
-            _ = pickle.load(f)
-            dataset = pickle.load(f)
+            _ = pickle.load(f)  # graftlint: disable=pickle-load-outside-compat(legacy HydraGNN .pkl loader shim gated behind warn_pickle_corpus_once)
+            _ = pickle.load(f)  # graftlint: disable=pickle-load-outside-compat(legacy loader shim, see above)
+            dataset = pickle.load(f)  # graftlint: disable=pickle-load-outside-compat(legacy loader shim, see above)
 
         if self.rotational_invariance:
             dataset = [normalize_rotation(s) for s in dataset]

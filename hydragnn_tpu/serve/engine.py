@@ -1763,6 +1763,7 @@ class InferenceEngine:
             return "torch"
         try:
             with open(path, "rb") as f:
+                # graftlint: disable=pickle-load-outside-compat(format sniffer for v1 legacy checkpoints — classification only, result discarded, errors swallowed)
                 payload = pickle.load(f)
             if isinstance(payload, dict) and "params" in payload:
                 return "native"

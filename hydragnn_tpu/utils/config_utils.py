@@ -254,6 +254,7 @@ def update_config_minmax(dataset_path: str, config: Dict[str, Any]):
         tables = {"node": np.asarray(node), "graph": np.asarray(graph)}
     else:
         with open(dataset_path, "rb") as f:
+            # graftlint: disable=pickle-load-outside-compat(legacy minmax-table shim for pre-GSHD corpora — the shard manifest branch above is the supported path)
             tables = {"node": pickle.load(f), "graph": pickle.load(f)}
     config["x_minmax"] = [
         tables["node"][:, i].tolist() for i in config["input_node_features"]

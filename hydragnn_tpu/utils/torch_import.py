@@ -49,6 +49,7 @@ def _to_np(t) -> np.ndarray:
 def _load_model_state_dict(path: str) -> Dict[str, np.ndarray]:
     import torch
 
+    # graftlint: disable=pickle-load-outside-compat(sanctioned torch-interop shim: weights_only=True restricted unpickler, tensors-and-containers only)
     ckpt = torch.load(path, map_location="cpu", weights_only=True)
     sd = ckpt["model_state_dict"] if "model_state_dict" in ckpt else ckpt
     # DDP checkpoints prefix every key with "module."

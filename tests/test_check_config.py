@@ -351,3 +351,35 @@ def pytest_rejects_bad_mesh():
     assert not any(
         e["code"] in ("bad-mesh", "bad-precision") for e in report["errors"]
     ), report["errors"]
+
+
+def pytest_rejects_bad_elastic_timing():
+    """Elastic liveness timing vs the ProxyRendezvous wire deadlines
+    (docs/DISTRIBUTED.md "Elastic runbook"): a heartbeat window at/above the
+    post or barrier deadline, or a pump tick below timer resolution, turns
+    slow epochs into hang-kills — rejected before any worker spawns."""
+
+    def _hb(v):
+        return lambda c: c["NeuralNetwork"]["Training"].update(
+            elastic={"min_workers": 1, "max_workers": 4, "heartbeat_s": v}
+        )
+
+    e = _expect("bad-elastic-timing", _hb(30.0), deep=False)
+    assert "post deadline" in str(e)
+    e = _expect("bad-elastic-timing", _hb(0.1), deep=False)
+    assert "pump interval" in str(e)
+    # 400 s overshoots BOTH wire deadlines — one finding per deadline.
+    e = _expect("bad-elastic-timing", _hb(400.0), deep=False)
+    msgs = [m for c, m in e.errors if c == "bad-elastic-timing"]
+    assert any("barrier deadline" in m for m in msgs), msgs
+    assert any("post deadline" in m for m in msgs), msgs
+    # The shipped default window (5 s: pump 1.25 s, well under post 10 s)
+    # stays clean.
+    config = _base()
+    config["NeuralNetwork"]["Training"].update(
+        elastic={"min_workers": 1, "max_workers": 4, "heartbeat_s": 5.0}
+    )
+    report = check_config(config, mode="training", strict=False, deep=False)
+    assert not any(
+        e["code"] == "bad-elastic-timing" for e in report["errors"]
+    ), report["errors"]
