@@ -570,6 +570,33 @@ def _last_known_flywheel(search_dir: "str | None" = None) -> "dict | None":
     return _latest_artifact_block("FLYWHEEL_*.json", extract, search_dir)
 
 
+def _last_known_pilot(search_dir: "str | None" = None) -> "dict | None":
+    """Most recent completed autopilot drill set from any committed PILOT_*
+    artifact — the graftpilot analog of ``_last_known_hardware``. A failed
+    ``--pilot`` round embeds this block with ``provenance: "stale"`` so an
+    rc=1 round still carries the last known fleet-autopilot verdicts."""
+
+    def extract(doc):
+        if not doc.get("drills_total") or "flash_crowd_drill" not in doc:
+            return None
+        crowd = doc.get("flash_crowd_drill") or {}
+        zero = doc.get("scale_to_zero_drill") or {}
+        return {
+            "drills_passed": doc.get("drills_passed"),
+            "drills_total": doc.get("drills_total"),
+            "lost_total": crowd.get("lost_total"),
+            "brownout_shed_non_ensemble": crowd.get(
+                "brownout_shed_non_ensemble"
+            ),
+            "scale_up_total": crowd.get("scale_up_total"),
+            "warmup_xla_compiles": zero.get("warmup_xla_compiles"),
+            "platform": doc.get("platform"),
+            "device_kind": doc.get("device_kind"),
+        }
+
+    return _latest_artifact_block("PILOT_*.json", extract, search_dir)
+
+
 def _last_known_faults(search_dir: "str | None" = None) -> "dict | None":
     """Most recent completed drill matrix from any committed FAULTS_*
     artifact — the fault-drill analog of ``_last_known_hardware``. A failed
@@ -2061,6 +2088,62 @@ def flywheel_main() -> int:
         return 1
 
 
+def pilot_main() -> int:
+    """``python bench.py --pilot``: run the fleet-autopilot drills
+    (benchmarks/pilot_drills.py — a 10x flash crowd under hysteresis
+    autoscaling + the brownout ladder, tenant-bulkhead isolation,
+    scale-to-zero with a zero-compile cold wake, and a replica kill under
+    autoscale) and print the block as the round's PILOT JSON line. Exit 1
+    when any drill fails; failure embeds the last known drill set
+    (stale-labeled), mirroring the other bench arms."""
+    result = {
+        "metric": "pilot_drills",
+        "value": 0.0,
+        "unit": "drills_passed",
+    }
+    try:
+        import jax
+
+        _with_retries(_probe_device)
+        result["backend"] = jax.default_backend()
+        result["device_kind"] = jax.devices()[0].device_kind
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.pilot_drills import run_pilot_benchmark
+
+        block = _with_retries(run_pilot_benchmark)
+        crowd = block["flash_crowd_drill"]
+        result["value"] = float(block["drills_passed"])
+        result["drills_passed"] = block["drills_passed"]
+        result["drills_total"] = block["drills_total"]
+        result["lost_total"] = crowd.get("lost_total")
+        result["brownout_shed_non_ensemble"] = crowd.get(
+            "brownout_shed_non_ensemble"
+        )
+        result["scale_up_total"] = crowd.get("scale_up_total")
+        result["warmup_xla_compiles"] = block["scale_to_zero_drill"].get(
+            "warmup_xla_compiles"
+        )
+        result["pilot"] = block
+        result["retries"] = _RETRIES_USED
+        ok = block["drills_passed"] == block["drills_total"]
+        print(json.dumps(result))
+        return 0 if ok else 1
+    except Exception as e:
+        import traceback
+
+        result["error"] = f"{type(e).__name__}: {e}"
+        result["trace_tail"] = traceback.format_exc()[-1500:]
+        result["retries"] = _RETRIES_USED
+        try:
+            stale = _last_known_pilot()
+            if stale is not None:
+                result["last_known_pilot"] = stale
+        except Exception:
+            pass
+        print(json.dumps(result))
+        return 1
+
+
 def _transient(e: Exception) -> bool:
     """Tunnel/RPC flaps surface as UNAVAILABLE transport errors (e.g.
     'remote_compile: Connection refused') or probe timeouts — retryable;
@@ -2310,6 +2393,8 @@ if __name__ == "__main__":
         sys.exit(swap_main())
     if "--flywheel" in sys.argv:
         sys.exit(flywheel_main())
+    if "--pilot" in sys.argv:
+        sys.exit(pilot_main())
     if "--faults" in sys.argv:
         sys.exit(faults_main())
     if "--packing" in sys.argv:

@@ -1,0 +1,537 @@
+"""graftpilot drill rig — the fleet-autopilot acceptance drills
+(ISSUE 20 / ROADMAP item 2; docs/SERVING.md "Fleet autopilot").
+
+Four drills against REAL engine fleets (in-process replicas, shared
+graftcache store), each returning an ``ok`` verdict plus its evidence:
+
+  flash_crowd_drill        a 10x offered-load step with the autopilot
+                           live: zero accepted requests lost, the brownout
+                           ladder sheds ONLY the lowest-priority class
+                           (the drill ladder structurally cannot touch
+                           'fast'), capacity is added under hysteresis,
+                           and the ladder recovers to level 0 after the
+                           wave with steady fleet p99 restored;
+  tenant_isolation_drill   a noisy tenant saturating its bulkhead is shed
+                           with tenant-tagged 429s while the victim
+                           tenant's traffic stays whole and inside SLO;
+  scale_to_zero_drill      sustained idle retires the whole fleet; the
+                           first failed request cold-wakes it through the
+                           shared graftcache store with ZERO XLA compiles
+                           (compile-spy gate);
+  kill_under_autoscale_drill  a replica is killed mid-load; the router
+                           loses zero accepted requests and the autopilot
+                           replaces + reaps the corpse without operator
+                           input.
+
+CPU runs measure control-loop plumbing (hysteresis, ladder walks,
+bulkheads, reconciliation), not TPU latency — the artifact labels the
+platform. ``python benchmarks/pilot_drills.py`` writes
+``PILOT_r<round>.json``; ``python bench.py --pilot`` wraps it with the
+stale-fallback contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hydragnn_tpu.utils.artifacts import round_tag  # noqa: E402
+
+from benchmarks.serve_load import (  # noqa: E402
+    build_router_fleet,
+    build_serving_engine,
+    router_open_loop,
+)
+
+
+def _pilot_config(**overrides):
+    from hydragnn_tpu.pilot import AutopilotConfig
+
+    base = dict(
+        scale_high=0.8,
+        scale_low=0.2,
+        sustain_up=2,
+        sustain_down=60,
+        cooldown_s=0.6,
+        spinup_wall_s=0.5,
+        min_replicas=1,
+        max_replicas=3,
+        per_replica_inflight=1,
+        predictive=False,
+        brownout_high=1.2,
+        brownout_low=0.3,
+        brownout_sustain=2,
+        # The drill ladder has NO shrink_queue rung: capping the bounded
+        # queue sheds the HIGHEST-priority class, and the flash-crowd gate
+        # is that only the lowest class is ever brownout-shed.
+        ladder=("shed_class:ensemble", "tighten_deadlines:0.5"),
+        tick_interval_s=0.05,
+    )
+    base.update(overrides)
+    return AutopilotConfig(**base)
+
+
+def _engine_factory(store, **engine_kw):
+    """Replica factory for the autopilot: a fresh engine hydrated from the
+    SHARED graftcache store (warm spin-up — zero XLA compiles)."""
+    from hydragnn_tpu.route import InProcessReplica
+
+    def factory(name):
+        engine, _ = build_serving_engine(compile_cache=store, **engine_kw)
+        return InProcessReplica(name, engine)
+
+    return factory
+
+
+def _close_fleet(router, autopilot, engines):
+    autopilot.stop()
+    router.close(close_replicas=True)
+    for e in engines:
+        try:
+            e.close()
+        except Exception:  # noqa: BLE001 — already closed via the router
+            pass
+
+
+# ------------------------------------------------------------ 1. flash crowd
+def flash_crowd_drill(
+    duration_s: float = 1.5, base_rps: float = 30.0, store: str | None = None
+) -> dict:
+    """10x offered-load step under a live autopilot."""
+    from hydragnn_tpu.pilot import Autopilot
+
+    engine_kw = dict(max_batch_graphs=8, max_delay_ms=2.0, pool_size=32)
+    router, engines, graphs, _ = build_router_fleet(
+        n_replicas=1,
+        compile_cache=store,
+        health_interval_s=0.05,
+        **engine_kw,
+    )
+    ap = Autopilot(
+        router, _engine_factory(store, **engine_kw), _pilot_config()
+    ).start()
+    try:
+        steady = router_open_loop(router, graphs, base_rps, duration_s)
+
+        # The wave: 10x 'fast' step + a background 'ensemble' trickle (the
+        # class the ladder sheds first — its 429s are the brownout
+        # evidence, never silent loss).
+        ensemble_block: dict = {}
+
+        def ensemble_trickle():
+            ensemble_block.update(
+                router_open_loop(
+                    router,
+                    graphs,
+                    base_rps / 2,
+                    duration_s * 2,
+                    klass="ensemble",
+                )
+            )
+
+        trickle = threading.Thread(target=ensemble_trickle, daemon=True)
+        trickle.start()
+        wave = router_open_loop(
+            router, graphs, base_rps * 10, duration_s * 2
+        )
+        trickle.join(120)
+
+        # Recovery: wait for the ladder to walk back to level 0.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and ap.ladder.level > 0:
+            time.sleep(0.1)
+        post = router_open_loop(router, graphs, base_rps, duration_s)
+
+        rsnap = router.metrics.snapshot()
+        per_class = rsnap["per_class"]
+        brownout_shed = rsnap["brownout_shed_total"]
+        pm = ap.metrics.snapshot()
+        states = {k: v["state"] for k, v in router.states().items()}
+        admitted = sum(1 for s in states.values() if s == "admitted")
+        lost = steady["lost"] + wave["lost"] + ensemble_block.get("lost", 1)
+        fast_shed = per_class.get("fast", {}).get("shed", 0)
+
+        # "Sheds ONLY the lowest class" is structural — the drill ladder's
+        # only shed rung names 'ensemble' and has no queue-cap rung — and
+        # cross-checked against the flight recorder: any route/shed record
+        # with a brownout reason tagged to another class is a hard fail.
+        from hydragnn_tpu.telemetry import snapshot_records
+
+        shed_reasons: dict = {}
+        brownout_shed_other = 0
+        for rec in snapshot_records():
+            if rec.get("name") != "route/shed":
+                continue
+            attrs = rec.get("attrs", {})
+            reason = attrs.get("reason", "?")
+            klass = attrs.get("klass", "?")
+            shed_reasons[f"{klass}/{reason}"] = (
+                shed_reasons.get(f"{klass}/{reason}", 0) + 1
+            )
+            if reason in ("brownout", "queue_cap") and klass != "ensemble":
+                brownout_shed_other += 1
+        p99_restored = (
+            post["fleet_p99_ms"] is not None
+            and steady["fleet_p99_ms"] is not None
+            and post["fleet_p99_ms"]
+            <= max(5.0 * steady["fleet_p99_ms"], 100.0)
+        )
+        return {
+            "drill": "flash_crowd",
+            "ok": (
+                lost == 0
+                and brownout_shed >= 1
+                and brownout_shed_other == 0
+                and pm["scale_up_total"] >= 1
+                and pm["brownout_step_total"] >= 1
+                and ap.ladder.level == 0
+                and p99_restored
+            ),
+            "lost_total": lost,
+            "fast_shed_429": fast_shed,
+            "ensemble_shed_429": per_class.get("ensemble", {}).get("shed", 0),
+            "brownout_shed_total": brownout_shed,
+            "brownout_shed_non_ensemble": brownout_shed_other,
+            "shed_reasons": shed_reasons,
+            "scale_up_total": pm["scale_up_total"],
+            "brownout_step_total": pm["brownout_step_total"],
+            "brownout_recover_total": pm["brownout_recover_total"],
+            "brownout_level_end": ap.ladder.level,
+            "admitted_end": admitted,
+            "p99_restored": p99_restored,
+            "steady": steady,
+            "wave": wave,
+            "ensemble_trickle": ensemble_block,
+            "post": post,
+        }
+    finally:
+        _close_fleet(router, ap, engines)
+
+
+# ------------------------------------------------------- 2. tenant isolation
+def tenant_isolation_drill(
+    duration_s: float = 1.5, victim_rps: float = 20.0
+) -> dict:
+    """Noisy tenant pinned inside its bulkhead; the victim stays whole."""
+    from hydragnn_tpu.pilot import Autopilot
+
+    engine_kw = dict(max_batch_graphs=8, max_delay_ms=2.0, pool_size=32)
+    router, engines, graphs, _ = build_router_fleet(
+        n_replicas=1, health_interval_s=0.05, **engine_kw
+    )
+    cfg = _pilot_config(
+        max_replicas=1,
+        tenant_inflight_quota=2,
+        tenant_retry_budget=8,
+        global_inflight_limit=64,
+    )
+    ap = Autopilot(router, _engine_factory(None, **engine_kw), cfg).start()
+    try:
+        outcomes = {"noisy": {}, "victim": {}}
+        latencies: dict = {"victim": []}
+
+        def drive(tenant, rps, record_latency=False, closed_loop=False):
+            n = max(1, int(duration_s * rps))
+            interval = 1.0 / rps
+            counts = outcomes[tenant]
+            lock = threading.Lock()
+
+            def one(i):
+                t0 = time.perf_counter()
+                try:
+                    router.predict(
+                        [graphs[i % len(graphs)]],
+                        request_id=f"{tenant}-{i}",
+                        tenant=tenant,
+                    )
+                    key = "ok"
+                    if record_latency:
+                        with lock:
+                            latencies["victim"].append(
+                                time.perf_counter() - t0
+                            )
+                except Exception as e:  # noqa: BLE001 — typed, not silent
+                    key = type(e).__name__
+                with lock:
+                    counts[key] = counts.get(key, 0) + 1
+
+            threads = []
+            t0 = time.perf_counter()
+            for i in range(n):
+                delay = t0 + i * interval - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                if closed_loop:
+                    # At most one request in flight: the caller can never
+                    # trip its OWN bulkhead quota, so every shed it sees
+                    # would be cross-tenant leakage — exactly the thing
+                    # the drill gates on.
+                    one(i)
+                    continue
+                th = threading.Thread(target=one, args=(i,), daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(60)
+
+        # Noisy floods at 10x the victim; its bulkhead holds 2 in flight.
+        noisy = threading.Thread(
+            target=drive, args=("noisy", victim_rps * 10), daemon=True
+        )
+        noisy.start()
+        drive("victim", victim_rps, record_latency=True, closed_loop=True)
+        noisy.join(120)
+
+        vl = sorted(latencies["victim"])
+        victim_p99_s = (
+            vl[min(len(vl) - 1, int(0.99 * len(vl)))] if vl else None
+        )
+        deadline_s = router.classes["fast"].deadline_s
+        noisy_shed = outcomes["noisy"].get("TenantQuotaError", 0)
+        victim_total = sum(outcomes["victim"].values())
+        victim_ok = outcomes["victim"].get("ok", 0)
+        pm = ap.metrics.snapshot()
+        return {
+            "drill": "tenant_isolation",
+            "ok": (
+                noisy_shed > 0
+                and victim_ok == victim_total
+                and victim_p99_s is not None
+                and victim_p99_s <= deadline_s
+            ),
+            "noisy_outcomes": outcomes["noisy"],
+            "victim_outcomes": outcomes["victim"],
+            "victim_p99_ms": round(victim_p99_s * 1000.0, 3)
+            if victim_p99_s is not None
+            else None,
+            "victim_slo_ms": deadline_s * 1000.0,
+            "tenant_shed_total": pm["tenant_shed_total"],
+            "per_tenant": pm["per_tenant"],
+        }
+    finally:
+        _close_fleet(router, ap, engines)
+
+
+# --------------------------------------------- 3. scale-to-zero + cold wake
+def scale_to_zero_drill(store: str) -> dict:
+    """Idle fleet retires to zero; the first failed request wakes it warm
+    (zero XLA compiles — the ladder hydrates from the shared store)."""
+    from hydragnn_tpu.analysis.sentinel import compile_count
+    from hydragnn_tpu.pilot import Autopilot
+    from hydragnn_tpu.route import InProcessReplica, NoReplicaAvailableError
+
+    engine_kw = dict(max_batch_graphs=8, max_delay_ms=2.0, pool_size=32)
+    router, engines, graphs, _ = build_router_fleet(
+        n_replicas=1,
+        compile_cache=store,
+        health_interval_s=0.05,
+        **engine_kw,
+    )
+    spawned: dict = {}
+
+    def factory(name):
+        engine, _ = build_serving_engine(
+            compile_cache=store, timing=spawned, **engine_kw
+        )
+        return InProcessReplica(name, engine)
+
+    cfg = _pilot_config(
+        min_replicas=0,
+        max_replicas=1,
+        idle_ticks_to_zero=2,
+        sustain_down=1000,
+    )
+    ap = Autopilot(router, factory, cfg)  # manual ticks: deterministic
+    try:
+        ap.tick(now=0.0)
+        ap.tick(now=1.0)
+        scaled_to_zero = router.states() == {} and ap.target == 0
+
+        failed_fast = False
+        try:
+            router.predict([graphs[0]], request_id="wake-1")
+        except NoReplicaAvailableError:
+            failed_fast = True
+        ap.tick(now=2.0)
+
+        woken = False
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            states = router.states()
+            if "pilot-1" in states:
+                router.poll_health()
+                if router.states()["pilot-1"]["state"] == "admitted":
+                    woken = True
+                    break
+            time.sleep(0.05)
+        res = router.predict([graphs[0]], request_id="wake-2") if woken else None
+        pm = ap.metrics.snapshot()
+        return {
+            "drill": "scale_to_zero_cold_wake",
+            "ok": (
+                scaled_to_zero
+                and failed_fast
+                and woken
+                and spawned.get("warmup_xla_compiles") == 0
+                and res is not None
+            ),
+            "scaled_to_zero": scaled_to_zero,
+            "failed_fast_503": failed_fast,
+            "woken_admitted": woken,
+            "warmup_xla_compiles": spawned.get("warmup_xla_compiles"),
+            "warmup_wall_s": spawned.get("warmup_wall_s"),
+            "scale_to_zero_total": pm["scale_to_zero_total"],
+            "cold_wake_total": pm["cold_wake_total"],
+            "xla_compiles_process": compile_count(),
+        }
+    finally:
+        _close_fleet(router, ap, engines)
+
+
+# ------------------------------------------- 4. kill under autoscale
+def kill_under_autoscale_drill(
+    duration_s: float = 1.5, rps: float = 30.0, store: str | None = None
+) -> dict:
+    """Kill a replica mid-load with the autopilot live: zero lost accepted
+    requests, the corpse is replaced and reaped without operator input."""
+    from hydragnn_tpu.faults import InjectedFault
+    from hydragnn_tpu.pilot import Autopilot
+
+    engine_kw = dict(max_batch_graphs=8, max_delay_ms=2.0, pool_size=32)
+    router, engines, graphs, _ = build_router_fleet(
+        n_replicas=2,
+        compile_cache=store,
+        health_interval_s=0.05,
+        **engine_kw,
+    )
+    cfg = _pilot_config(min_replicas=2, max_replicas=3, eject_grace_ticks=3)
+    ap = Autopilot(
+        router, _engine_factory(store, **engine_kw), cfg
+    ).start()
+    try:
+
+        def kill():
+            engines[0]._fail(InjectedFault("drill: replica-0 killed"))
+
+        drill = router_open_loop(
+            router, graphs, rps, duration_s, mid_load_hook=kill
+        )
+
+        # The autopilot replaces the corpse and reaps it after grace.
+        replaced = reaped = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            states = {k: v["state"] for k, v in router.states().items()}
+            replaced = any(
+                k.startswith("pilot-") and s == "admitted"
+                for k, s in states.items()
+            )
+            reaped = "replica-0" not in states
+            if replaced and reaped:
+                break
+            time.sleep(0.1)
+        post = router_open_loop(router, graphs, rps, duration_s)
+        pm = ap.metrics.snapshot()
+        return {
+            "drill": "kill_under_autoscale",
+            "ok": (
+                drill["lost"] == 0
+                and post["lost"] == 0
+                and replaced
+                and reaped
+                and pm["replace_total"] >= 1
+            ),
+            "lost_total": drill["lost"] + post["lost"],
+            "replaced": replaced,
+            "corpse_reaped": reaped,
+            "replace_total": pm["replace_total"],
+            "reap_total": pm["reap_total"],
+            "drill_load": drill,
+            "post_load": post,
+        }
+    finally:
+        _close_fleet(router, ap, engines)
+
+
+# ---------------------------------------------------------------- artifact
+def run_pilot_benchmark(
+    duration_s: float = 1.5,
+    base_rps: float = 30.0,
+    out_path: "str | None" = None,
+) -> dict:
+    """The fleet-autopilot artifact (``PILOT_rNN.json``): all four drills +
+    the graftel pilot decision trail."""
+    import jax
+
+    block = {
+        "ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "model": "PNA hidden=8 x2 (graph+node heads)",
+        "base_offered_graphs_per_sec": base_rps,
+        "note": "CPU runs measure autopilot control plumbing (hysteresis, "
+        "brownout walks, bulkheads, reconciliation), not TPU latency",
+    }
+    with tempfile.TemporaryDirectory() as cache_dir:
+        block["flash_crowd_drill"] = flash_crowd_drill(
+            duration_s, base_rps, store=os.path.join(cache_dir, "crowd")
+        )
+        block["tenant_isolation_drill"] = tenant_isolation_drill(duration_s)
+        block["scale_to_zero_drill"] = scale_to_zero_drill(
+            os.path.join(cache_dir, "zero")
+        )
+        block["kill_under_autoscale_drill"] = kill_under_autoscale_drill(
+            duration_s, base_rps, store=os.path.join(cache_dir, "kill")
+        )
+    drills = [
+        block["flash_crowd_drill"],
+        block["tenant_isolation_drill"],
+        block["scale_to_zero_drill"],
+        block["kill_under_autoscale_drill"],
+    ]
+    block["drills_total"] = len(drills)
+    block["drills_passed"] = sum(1 for d in drills if d.get("ok"))
+
+    # graftel census: the pilot decision trail.
+    from hydragnn_tpu import telemetry
+
+    counts = telemetry.span_counts(telemetry.snapshot_records())
+    block["telemetry"] = {
+        "span_counts": {
+            name: n
+            for name, n in sorted(counts.items())
+            if name.startswith(("pilot/", "route/replica_retire"))
+        }
+    }
+
+    if out_path is None:
+        out_path = os.path.join(REPO, f"PILOT_r{round_tag()}.json")
+    with open(out_path, "w") as f:
+        json.dump(block, f, indent=2)
+    block["artifact"] = os.path.basename(out_path)
+    return block
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--duration", type=float, default=1.5)
+    ap.add_argument("--rps", type=float, default=30.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    block = run_pilot_benchmark(
+        duration_s=args.duration, base_rps=args.rps, out_path=args.out
+    )
+    print(json.dumps(block))
+    return 0 if block["drills_passed"] == block["drills_total"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,7 @@ import json
 import os
 import sys
 import tempfile
+import time
 
 
 def _preparse(flag: str, argv, default: str) -> str:
@@ -539,6 +540,92 @@ def _flywheel_drill(tmpdir: str) -> None:
         shadow.close()
 
 
+def _autoscale_drill() -> None:
+    """graftpilot path (ISSUE 20): the autopilot tick thread racing
+    caller-thread dispatch, the router health loop, and tenant-bulkhead
+    charging — Autopilot._lock / PilotMetrics._lock / BrownoutLadder._lock /
+    TenantBulkheads._lock against Router._lock and the engine locks exactly
+    as in production. One replica dies mid-drill: health ejects it while the
+    pilot replaces the corpse and dispatch routes around it. min == max
+    replicas pins the reactive arm so the spawn count is deterministic
+    (exactly the one replacement)."""
+    from benchmarks.serve_load import build_serving_engine
+    from hydragnn_tpu.pilot import Autopilot, AutopilotConfig
+    from hydragnn_tpu.route import InProcessReplica, Router
+
+    engines = []
+    replicas = []
+    for i in range(2):
+        engine, graphs = build_serving_engine(
+            hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0,
+            pool_size=_SERVE_REQUESTS,
+        )
+        engines.append(engine)
+        replicas.append(InProcessReplica(f"drill-{i}", engine))
+    router = Router(
+        replicas,
+        health_interval_s=0.02,
+        jitter_seed=0,
+        autostart_health=True,
+    )
+
+    def factory(name):
+        engine, _ = build_serving_engine(
+            hidden=4, layers=1, max_batch_graphs=4, max_delay_ms=5.0,
+            pool_size=_SERVE_REQUESTS,
+        )
+        engines.append(engine)
+        return InProcessReplica(name, engine)
+
+    cfg = AutopilotConfig(
+        min_replicas=2,
+        max_replicas=2,
+        sustain_down=10_000,
+        eject_grace_ticks=2,
+        tenant_inflight_quota=4,
+        global_inflight_limit=64,
+        predictive=False,
+        tick_interval_s=0.005,
+    )
+    ap = Autopilot(router, factory, cfg).start()
+    try:
+        for i in range(_SERVE_REQUESTS):
+            router.predict(
+                [graphs[i]],
+                request_id=f"pilot-drill-{i}",
+                tenant=f"t{i % 2}",
+            )
+        # Kill one replica: health ejects it while the pilot's tick thread
+        # replaces it and dispatch keeps routing around the corpse.
+        engines[0].close()
+        for i in range(_SERVE_REQUESTS):
+            router.predict(
+                [graphs[i]],
+                request_id=f"pilot-drill2-{i}",
+                tenant=f"t{i % 2}",
+            )
+        # The replacement MUST land inside the drill window (a run that
+        # exits before the spawn would record a different visit count).
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            states = router.states()
+            if any(
+                n.startswith("pilot-") and s["state"] == "admitted"
+                for n, s in states.items()
+            ):
+                break
+            time.sleep(0.01)
+        else:
+            raise RuntimeError(f"pilot never replaced the corpse: {states}")
+        ap.metrics.render_prometheus()  # the /metrics cross-thread read
+        ap.report()  # the /pilotz cross-thread read
+    finally:
+        ap.stop()
+        router.close()
+        for engine in engines:
+            engine.close()
+
+
 def _proto_drill(seed: int) -> dict:
     """graftproto path (ISSUE 19): the static SPMD/barrier lockstep pass
     over the package plus the crash-consistency SMOKE sweep (elastic shrink
@@ -573,6 +660,7 @@ def run_drill(seed: int) -> dict:
         _elastic_drill()
         _stream_drill(tmpdir)
         _flywheel_drill(tmpdir)
+        _autoscale_drill()
     rep = tsan.report()
     static = trace_paths([os.path.join(REPO, "hydragnn_tpu")], root=REPO)
     cross = tsan.cross_check(static.lock_edges)

@@ -49,6 +49,12 @@ Every failure is one actionable line tagged with a stable code:
                     the shadow gate window, keep_last_k < 3 with
                     auto-promotion enabled, flywheel with checkpoint_async
                     off) — docs/FLYWHEEL.md
+  bad-pilot         fleet-autopilot nonsense (inverted/degenerate scale or
+                    brownout watermarks, cooldown shorter than the replica
+                    spin-up wall, an empty or severity-unordered brownout
+                    ladder, a per-tenant quota wider than the global
+                    in-flight bound, min_replicas > max_replicas) —
+                    docs/SERVING.md "Fleet autopilot"
   donation-misuse   config requests a donating step that would alias buffers
   shape-mismatch    eval_shape found inconsistent shapes/dtypes end to end
 
@@ -108,6 +114,7 @@ def check_config(
     router: Optional[Dict[str, Any]] = None,
     lifecycle: Optional[Dict[str, Any]] = None,
     flywheel: Optional[Dict[str, Any]] = None,
+    pilot: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Validate a training or serving config statically. Returns the report
     dict; with ``strict`` (the default) raises :class:`ConfigContractError`
@@ -130,7 +137,12 @@ def check_config(
     or the supervisor's flywheel block: ``{"auto_promote",
     "shadow_tolerance", "drift_high", "drift_low", "refit_interval_s",
     "gate_window_s", "keep_last_k"}``); flywheel nonsense is a
-    ``bad-flywheel`` finding through this same gate."""
+    ``bad-flywheel`` finding through this same gate.
+    ``pilot`` is the graftpilot config dict (``AutopilotConfig.to_json()``:
+    ``{"scale_high", "scale_low", "cooldown_s", "spinup_wall_s",
+    "min_replicas", "max_replicas", "ladder", "tenant_inflight_quota",
+    "global_inflight_limit", ...}``); autopilot nonsense is a ``bad-pilot``
+    finding through this same gate."""
     if isinstance(config, str):
         with open(config) as f:
             config = json.load(f)
@@ -158,6 +170,8 @@ def check_config(
         _check_lifecycle(lifecycle, arch, training, completed, errors)
     if flywheel is not None:
         _check_flywheel(flywheel, training, errors)
+    if pilot is not None:
+        _check_pilot(pilot, errors)
     _check_donation(training, errors)
     _check_aggregation_path(arch, errors)
 
@@ -221,6 +235,7 @@ def gate_config(
     router=None,
     lifecycle=None,
     flywheel=None,
+    pilot=None,
 ):
     """The ONE entry-point gate shared by run_training / run_prediction /
     serve startup: honors ``HYDRAGNN_CHECK_CONFIG`` (``full`` default,
@@ -242,6 +257,7 @@ def gate_config(
         router=router,
         lifecycle=lifecycle,
         flywheel=flywheel,
+        pilot=pilot,
     )
 
 
@@ -918,6 +934,104 @@ def _check_flywheel(flywheel, training, errors):
                 "rides the async saver's post-save callback, and a "
                 "synchronous save would stall the training step for the "
                 "full stage-and-arm round trip",
+            )
+        )
+
+
+def _check_pilot(pilot, errors):
+    """graftpilot config contract (docs/SERVING.md "Fleet autopilot"): a
+    misconfigured autopilot does not fail loudly — it flaps the fleet
+    (inverted watermarks), double-scales every wave (cooldown shorter than
+    the spin-up wall), browns out the HIGHEST-priority class first (an
+    unordered ladder), or lets one tenant fill the whole router (quota
+    wider than the global bound). Each is one actionable ``bad-pilot``
+    line before the control thread starts. Mirrors
+    ``pilot.AutopilotConfig.__post_init__`` — what the gate rejects, the
+    constructor rejects too."""
+    import math
+
+    def _num(key):
+        v = pilot.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        f = float(v)
+        return f if math.isfinite(f) else None
+
+    for low_key, high_key in (
+        ("scale_low", "scale_high"),
+        ("brownout_low", "brownout_high"),
+    ):
+        low, high = _num(low_key), _num(high_key)
+        present = pilot.get(low_key) is not None or pilot.get(high_key) is not None
+        if present and (
+            low is None or high is None or not (0.0 <= low < high)
+        ):
+            errors.append(
+                (
+                    "bad-pilot",
+                    f"{low_key}/{high_key} must satisfy 0 <= low < high "
+                    f"(got {pilot.get(low_key)!r}/{pilot.get(high_key)!r}) — "
+                    "an inverted or degenerate pair removes the dead band "
+                    "and the autoscaler flaps on boundary noise",
+                )
+            )
+    cooldown = _num("cooldown_s")
+    spinup = _num("spinup_wall_s")
+    if cooldown is not None and spinup is not None and cooldown < spinup:
+        errors.append(
+            (
+                "bad-pilot",
+                f"cooldown_s ({cooldown!r}) must cover spinup_wall_s "
+                f"({spinup!r}) — re-deciding while the previous replica is "
+                "still warming double-scales on every wave",
+            )
+        )
+    ladder = pilot.get("ladder")
+    if ladder is not None:
+        from ..pilot.brownout import parse_ladder
+
+        try:
+            parse_ladder(ladder)
+        except (ValueError, TypeError) as e:
+            errors.append(("bad-pilot", f"brownout ladder invalid: {e}"))
+    quota = _num("tenant_inflight_quota")
+    bound = _num("global_inflight_limit")
+    if quota is not None and bound is not None and quota > bound:
+        errors.append(
+            (
+                "bad-pilot",
+                f"tenant_inflight_quota ({quota!r}) exceeds "
+                f"global_inflight_limit ({bound!r}) — one tenant's bulkhead "
+                "would be wide enough to fill the whole fleet, which is no "
+                "bulkhead at all",
+            )
+        )
+    mn = _num("min_replicas")
+    mx = _num("max_replicas")
+    if mn is not None and mn < 0:
+        errors.append(
+            ("bad-pilot", f"min_replicas must be >= 0, got {mn!r}")
+        )
+    if mx is not None and mx < 1:
+        errors.append(
+            ("bad-pilot", f"max_replicas must be >= 1, got {mx!r}")
+        )
+    if mn is not None and mx is not None and mn > mx:
+        errors.append(
+            (
+                "bad-pilot",
+                f"min_replicas ({mn!r}) > max_replicas ({mx!r}) — the "
+                "reconciler's clamp range is empty and the target is "
+                "undefined",
+            )
+        )
+    idle = _num("idle_ticks_to_zero")
+    if idle is not None and idle > 0 and mn is not None and mn != 0:
+        errors.append(
+            (
+                "bad-pilot",
+                f"idle_ticks_to_zero ({idle!r}) requires min_replicas == 0 "
+                f"(got {mn!r}) — scale-to-zero retires the whole fleet",
             )
         )
 

@@ -7,7 +7,7 @@ flight dump), and serve-traffic size histograms drive drift-triggered
 bucket-ladder refits swapped hot across the fleet.
 """
 
-from .drift import DriftDetector
+from .drift import DriftDetector, Hysteresis
 from .loop import Flywheel, FlywheelConfig
 
-__all__ = ["DriftDetector", "Flywheel", "FlywheelConfig"]
+__all__ = ["DriftDetector", "Flywheel", "FlywheelConfig", "Hysteresis"]

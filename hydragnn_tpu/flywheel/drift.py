@@ -44,6 +44,84 @@ def _as_rows(hist: "SizeHistogram | Sequence[Tuple[int, int, int]]") -> Rows:
     return [(int(n), int(e), int(w)) for n, e, w in hist]
 
 
+class Hysteresis:
+    """The bare sustain-to-enter / low-watermark-exit state machine — the
+    dead-band contract shared by the drift detector and the fleet autopilot
+    (pilot/autopilot.py watermarks ride the SAME machine, so both actuators
+    inherit the no-flap guarantee from one implementation).
+
+    * inactive -> active only after ``sustain`` CONSECUTIVE ``step`` values
+      at or above ``high`` (any value below ``high`` resets the count —
+      including values inside the band);
+    * active -> inactive only on a value strictly below ``low``;
+    * the band ``[low, high)`` holds whichever state the machine is in.
+
+    Unlike the drift detector's thresholds, ``high``/``low`` are NOT bounded
+    above by 1 — autopilot pressure is demand over capacity and legitimately
+    exceeds 1 during a flash crowd. Not itself thread-safe: every holder
+    (DriftDetector, Autopilot) steps and reads it under its own lock, the
+    same external-guard pattern as the router's ``_ReplicaEntry``.
+    """
+
+    __slots__ = ("high", "low", "sustain", "_over", "_active",
+                 "enters_total", "exits_total")
+
+    def __init__(self, high: float, low: float, sustain: int = 3):
+        if not (0.0 <= float(low) < float(high)):
+            raise ValueError(
+                f"hysteresis watermarks must satisfy 0 <= low < high, got "
+                f"low={low!r} high={high!r} (equal watermarks would remove "
+                "the dead band — the no-flap guarantee)"
+            )
+        if int(sustain) < 1:
+            raise ValueError(f"sustain must be >= 1, got {sustain}")
+        self.high = float(high)
+        self.low = float(low)
+        self.sustain = int(sustain)
+        self._over = 0  # guarded-by: external(the holder's lock)
+        self._active = False  # guarded-by: external(the holder's lock)
+        self.enters_total = 0  # guarded-by: external(the holder's lock)
+        self.exits_total = 0  # guarded-by: external(the holder's lock)
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    @property
+    def over(self) -> int:
+        """Consecutive at-or-over-``high`` count while inactive."""
+        return self._over
+
+    def step(self, value: float) -> Optional[str]:
+        """One evaluation: returns ``"entered"``, ``"exited"``, or None."""
+        v = float(value)
+        if not self._active:
+            if v >= self.high:
+                self._over += 1
+                if self._over >= self.sustain:
+                    self._active = True
+                    self.enters_total += 1
+                    return "entered"
+            else:
+                # Below HIGH resets the sustain count — including the
+                # hysteresis band: entry requires consecutive evidence.
+                self._over = 0
+        else:
+            if v < self.low:
+                self._active = False
+                self._over = 0
+                self.exits_total += 1
+                return "exited"
+            # low <= v: stays active (the band holds the state).
+        return None
+
+    def reset(self) -> None:
+        """Back to inactive with a cleared sustain count (transition
+        counters are cumulative and survive — they are evidence)."""
+        self._over = 0
+        self._active = False
+
+
 class DriftDetector:
     """Windowed histogram-distance drift detector with hysteresis."""
 
@@ -85,12 +163,11 @@ class DriftDetector:
         # Sliding window of per-tick observation blocks (each block is the
         # delta the flywheel pulled from serve metrics since its last tick).
         self._window: Deque[Rows] = deque(maxlen=self.window)  # guarded-by: self._lock
-        self._over = 0  # consecutive evaluations >= high  # guarded-by: self._lock
-        self._drifted = False  # guarded-by: self._lock
+        # The shared sustain/dead-band machine (Hysteresis) — the autopilot
+        # steps the same class for its scale watermarks.
+        self._machine = Hysteresis(self.high, self.low, self.sustain)  # guarded-by: self._lock
         self._distance: Optional[float] = None  # last evaluation  # guarded-by: self._lock
         self.evals_total = 0  # guarded-by: self._lock
-        self.enters_total = 0  # guarded-by: self._lock
-        self.exits_total = 0  # guarded-by: self._lock
 
     # -------------------------------------------------------------- feeding
     def observe(
@@ -121,37 +198,19 @@ class DriftDetector:
                 self.evals_total += 1
                 return {
                     "distance": None,
-                    "drifted": self._drifted,
-                    "over": self._over,
+                    "drifted": self._machine.active,
+                    "over": self._machine.over,
                     "transition": None,
                 }
         d = histogram_distance(source, merged, **self._quant)
-        transition = None
         with self._lock:
             self.evals_total += 1
             self._distance = d
-            if not self._drifted:
-                if d >= self.high:
-                    self._over += 1
-                    if self._over >= self.sustain:
-                        self._drifted = True
-                        self.enters_total += 1
-                        transition = "entered"
-                else:
-                    # Below HIGH resets the sustain count — including the
-                    # hysteresis band: entry requires consecutive evidence.
-                    self._over = 0
-            else:
-                if d < self.low:
-                    self._drifted = False
-                    self._over = 0
-                    self.exits_total += 1
-                    transition = "exited"
-                # low <= d: stays drifted (the band holds the state).
+            transition = self._machine.step(d)
             out = {
                 "distance": round(d, 6),
-                "drifted": self._drifted,
-                "over": self._over,
+                "drifted": self._machine.active,
+                "over": self._machine.over,
                 "transition": transition,
             }
         return out
@@ -180,28 +239,27 @@ class DriftDetector:
         with self._lock:
             self._source = rows
             self._window.clear()
-            self._over = 0
-            self._drifted = False
+            self._machine.reset()
             self._distance = None
 
     # -------------------------------------------------------------- status
     @property
     def drifted(self) -> bool:
         with self._lock:
-            return self._drifted
+            return self._machine.active
 
     def report(self) -> Dict[str, Any]:
         with self._lock:
             return {
-                "drifted": self._drifted,
+                "drifted": self._machine.active,
                 "distance": self._distance,
-                "over": self._over,
+                "over": self._machine.over,
                 "high": self.high,
                 "low": self.low,
                 "window": self.window,
                 "sustain": self.sustain,
                 "window_blocks": len(self._window),
                 "evals_total": self.evals_total,
-                "enters_total": self.enters_total,
-                "exits_total": self.exits_total,
+                "enters_total": self._machine.enters_total,
+                "exits_total": self._machine.exits_total,
             }

@@ -1,0 +1,37 @@
+"""graftpilot — fleet autopilot (docs/SERVING.md "Fleet autopilot";
+ROADMAP item 2).
+
+Predictive autoscaling with hysteresis, a brownout degradation ladder,
+and tenant-isolation bulkheads over the graftroute multi-replica tier:
+
+  autopilot.py  the ``hydragnn-pilot`` control loop — one locked sensor
+                read (``Router.control_snapshot``), a reactive arm on the
+                shared ``Hysteresis`` dead-band machine (flywheel/drift),
+                a predictive arm fit from streaming size-histogram deltas,
+                scale-to-zero + warm cold-wake through graftcache;
+  brownout.py   ordered reversible degradation (shed the lowest class →
+                tighten deadlines → shrink the queue), walked under the
+                same no-flap hysteresis discipline;
+  tenants.py    per-tenant in-flight quotas + retry-budget token buckets,
+                shed as tenant-tagged 429s before fleet capacity is spent;
+  metrics.py    the ``hydragnn_pilot_*`` Prometheus family.
+
+Drills: ``python benchmarks/bench.py --pilot`` (flash crowd, tenant
+isolation, scale-to-zero/cold-wake, kill-under-autoscale) →
+``benchmarks/PILOT_r*.json``.
+"""
+
+from .autopilot import Autopilot, AutopilotConfig
+from .brownout import STEP_SEVERITY, BrownoutLadder, parse_ladder
+from .metrics import PilotMetrics
+from .tenants import TenantBulkheads
+
+__all__ = [
+    "STEP_SEVERITY",
+    "Autopilot",
+    "AutopilotConfig",
+    "BrownoutLadder",
+    "PilotMetrics",
+    "TenantBulkheads",
+    "parse_ladder",
+]

@@ -1,0 +1,680 @@
+"""graftpilot — the fleet autopilot control loop (docs/SERVING.md "Fleet
+autopilot"; ROADMAP item 2).
+
+One daemon thread (``hydragnn-pilot``) closes the loop between the
+router's sensors and its actuators:
+
+  sense   Router.control_snapshot() — ONE locked read of queue depth,
+          per-class sheds, rolling fleet p99 vs SLO deadlines, and
+          per-replica lifecycle states (satellite: the torn-counter-pair
+          reasoning from the PR-8 scrape bug, applied to a control input);
+  decide  three coupled arms —
+            * reactive autoscaler: pressure through a ``Hysteresis``
+              dead-band (the SAME machine the flywheel's DriftDetector
+              runs on — flywheel/drift.py) with a cooldown floored at the
+              measured replica spin-up wall, so the loop cannot flap or
+              re-fire while a previous spin-up is still warming;
+            * predictive autoscaler: demand rate from streaming
+              size-histogram deltas, least-squares slope over a short
+              window, scale when the rate *projected one spin-up wall
+              ahead* exceeds fleet capacity — ahead of the wave, not
+              behind it;
+            * brownout ladder (brownout.py): ordered reversible
+              degradation while capacity catches up;
+  act     Router.scale_up (warm, through the shared graftcache store —
+          a woken replica does ZERO XLA compiles), Router.scale_down →
+          reap_retired (drain without dropping in-flight work), and
+          replacement of ejected corpses.
+
+Scale-to-zero: with ``min_replicas=0`` and sustained zero traffic the
+pilot retires the whole fleet; the first request after that fails fast
+(503, retryable) and its failure is the cold-wake signal — the next tick
+spins a replica from the warm cache, bypassing the cooldown.
+
+Determinism for tests/drills: ``tick(now=...)`` injects the clock and the
+loop thread is optional — exactly the flywheel's discipline. Engine
+closes NEVER happen on the pilot (or health) thread: retired/ejected
+replicas accumulate and are closed by ``close_retired()`` / ``stop()`` on
+the caller's thread (an engine close joins worker threads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
+
+from ..analysis import tsan
+from ..flywheel.drift import Hysteresis
+from ..route.replica import Replica
+from ..route.router import ADMITTED, DRAINING, EJECTED, WARMING, Router
+from ..telemetry import graftel as telemetry
+from .brownout import BrownoutLadder, parse_ladder
+from .metrics import PilotMetrics
+from .tenants import TenantBulkheads
+
+
+@dataclass
+class AutopilotConfig:
+    """Tunables for one autopilot. ``__post_init__`` enforces at runtime
+    exactly what ``contracts._check_pilot`` flags statically (``bad-pilot``
+    findings) — a config that passes the gate constructs, one that fails
+    it raises here too."""
+
+    # Reactive arm: pressure watermarks (dead band) + sustain + cooldown.
+    scale_high: float = 0.85
+    scale_low: float = 0.3
+    sustain_up: int = 2
+    sustain_down: int = 8
+    cooldown_s: float = 3.0
+    # The measured (or assumed) replica spin-up wall. The cooldown must
+    # cover it: re-deciding while the previous decision is still warming
+    # double-scales on every wave.
+    spinup_wall_s: float = 2.0
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Capacity model: in-flight slots one replica handles comfortably.
+    per_replica_inflight: int = 4
+    # Predictive arm.
+    predictive: bool = True
+    predict_window: int = 8
+    predict_lead_s: float = 0.5
+    per_replica_rps: float = 50.0
+    # Scale-to-zero: retire the whole fleet after this many consecutive
+    # zero-traffic ticks (0 disables; requires min_replicas == 0).
+    idle_ticks_to_zero: int = 0
+    # Brownout ladder.
+    brownout_high: float = 1.5
+    brownout_low: float = 0.5
+    brownout_sustain: int = 2
+    ladder: Tuple[str, ...] = (
+        "shed_class:ensemble",
+        "tighten_deadlines:0.5",
+        "shrink_queue:8",
+    )
+    # Tenant bulkheads (0 quota disables them entirely).
+    tenant_inflight_quota: int = 0
+    tenant_retry_budget: int = 16
+    tenant_retry_refill_per_s: float = 8.0
+    # The global bound a per-tenant quota must stay inside: one tenant's
+    # bulkhead must never be wide enough to fill the whole fleet.
+    global_inflight_limit: int = 64
+    per_tenant: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # Ejected corpses are reaped (removed + closed) after this many ticks
+    # of grace — long enough for /healthz post-mortems, short enough that
+    # the table doesn't grow without bound.
+    eject_grace_ticks: int = 10
+    tick_interval_s: float = 0.25
+
+    def __post_init__(self):
+        if not (0 <= float(self.scale_low) < float(self.scale_high)):
+            raise ValueError(
+                "scale watermarks need 0 <= scale_low < scale_high, got "
+                f"low={self.scale_low} high={self.scale_high}"
+            )
+        if not (0 <= float(self.brownout_low) < float(self.brownout_high)):
+            raise ValueError(
+                "brownout watermarks need 0 <= low < high, got "
+                f"low={self.brownout_low} high={self.brownout_high}"
+            )
+        if float(self.cooldown_s) < float(self.spinup_wall_s):
+            raise ValueError(
+                f"cooldown_s ({self.cooldown_s}) must cover the spin-up "
+                f"wall ({self.spinup_wall_s}): re-deciding while the last "
+                "replica is still warming double-scales every wave"
+            )
+        if int(self.min_replicas) < 0 or int(self.max_replicas) < 1:
+            raise ValueError(
+                f"need min_replicas >= 0 and max_replicas >= 1, got "
+                f"{self.min_replicas}/{self.max_replicas}"
+            )
+        if int(self.min_replicas) > int(self.max_replicas):
+            raise ValueError(
+                f"min_replicas ({self.min_replicas}) > max_replicas "
+                f"({self.max_replicas})"
+            )
+        if int(self.sustain_up) < 1 or int(self.sustain_down) < 1:
+            raise ValueError("sustain_up/sustain_down must be >= 1")
+        if int(self.per_replica_inflight) < 1:
+            raise ValueError("per_replica_inflight must be >= 1")
+        if float(self.per_replica_rps) <= 0:
+            raise ValueError("per_replica_rps must be > 0")
+        if int(self.predict_window) < 2:
+            raise ValueError("predict_window must be >= 2")
+        if int(self.idle_ticks_to_zero) > 0 and int(self.min_replicas) != 0:
+            raise ValueError(
+                "idle_ticks_to_zero needs min_replicas == 0 "
+                "(scale-to-zero retires the whole fleet)"
+            )
+        if int(self.tenant_inflight_quota) < 0:
+            raise ValueError("tenant_inflight_quota must be >= 0")
+        if int(self.tenant_inflight_quota) > int(self.global_inflight_limit):
+            raise ValueError(
+                f"tenant_inflight_quota ({self.tenant_inflight_quota}) "
+                f"exceeds global_inflight_limit "
+                f"({self.global_inflight_limit}): one tenant could fill "
+                "the whole fleet — no bulkhead at all"
+            )
+        if float(self.tick_interval_s) <= 0:
+            raise ValueError("tick_interval_s must be > 0")
+        parse_ladder(self.ladder)  # empty/unknown/unordered raise here
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "scale_high": self.scale_high,
+            "scale_low": self.scale_low,
+            "sustain_up": self.sustain_up,
+            "sustain_down": self.sustain_down,
+            "cooldown_s": self.cooldown_s,
+            "spinup_wall_s": self.spinup_wall_s,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "per_replica_inflight": self.per_replica_inflight,
+            "predictive": self.predictive,
+            "predict_window": self.predict_window,
+            "predict_lead_s": self.predict_lead_s,
+            "per_replica_rps": self.per_replica_rps,
+            "idle_ticks_to_zero": self.idle_ticks_to_zero,
+            "brownout_high": self.brownout_high,
+            "brownout_low": self.brownout_low,
+            "brownout_sustain": self.brownout_sustain,
+            "ladder": list(self.ladder),
+            "tenant_inflight_quota": self.tenant_inflight_quota,
+            "tenant_retry_budget": self.tenant_retry_budget,
+            "tenant_retry_refill_per_s": self.tenant_retry_refill_per_s,
+            "global_inflight_limit": self.global_inflight_limit,
+            "eject_grace_ticks": self.eject_grace_ticks,
+            "tick_interval_s": self.tick_interval_s,
+        }
+
+
+class Autopilot:
+    """The control loop. ``factory(name) -> Replica`` builds a new replica
+    (pointed at the shared graftcache store, so spin-ups are warm);
+    ``histogram_sources`` yields objects exposing ``histogram_json()``
+    (graftstream size-histogram telemetry) whose weight deltas are the
+    predictive arm's demand signal — without sources the arm falls back to
+    the fleet's own request-counter deltas (reactive-ish, but still
+    slope-projected)."""
+
+    def __init__(
+        self,
+        router: Router,
+        factory: Callable[[str], Replica],
+        config: Optional[AutopilotConfig] = None,
+        histogram_sources: Iterable[Any] = (),
+        metrics: Optional[PilotMetrics] = None,
+        name_prefix: str = "pilot",
+    ):
+        self.router = router
+        self.factory = factory
+        self.config = config if config is not None else AutopilotConfig()
+        self.metrics = metrics if metrics is not None else PilotMetrics()
+        self.histogram_sources = histogram_sources
+        self.name_prefix = str(name_prefix)
+        cfg = self.config
+        self.ladder = BrownoutLadder(
+            router,
+            cfg.ladder,
+            high=cfg.brownout_high,
+            low=cfg.brownout_low,
+            sustain=cfg.brownout_sustain,
+            metrics=self.metrics,
+        )
+        self.bulkheads: Optional[TenantBulkheads] = None
+        if cfg.tenant_inflight_quota > 0:
+            self.bulkheads = TenantBulkheads(
+                inflight_quota=cfg.tenant_inflight_quota,
+                retry_budget=cfg.tenant_retry_budget,
+                retry_refill_per_s=cfg.tenant_retry_refill_per_s,
+                per_tenant=cfg.per_tenant,
+                metrics=self.metrics,
+            )
+            router.set_bulkheads(self.bulkheads)
+
+        self._lock = tsan.instrument_lock(threading.Lock(), "Autopilot._lock")
+        # Reactive dead-band machine — same external-guard discipline as
+        # DriftDetector's (not internally locked; all touches below hold
+        # self._lock).
+        self._scale = Hysteresis(  # guarded-by: self._lock
+            cfg.scale_high, cfg.scale_low, cfg.sustain_up
+        )
+        self._under = 0  # consecutive ticks below scale_low  # guarded-by: self._lock
+        self._idle = 0  # consecutive zero-traffic ticks  # guarded-by: self._lock
+        self._spawned = 0  # pilot-N name counter  # guarded-by: self._lock
+        self._last_scale_t: Optional[float] = None  # guarded-by: self._lock
+        self._last_tick_t: Optional[float] = None  # guarded-by: self._lock
+        # Demand-rate samples (ts, rps) for the predictive least-squares.
+        self._rate_samples: Deque[Tuple[float, float]] = deque(  # guarded-by: self._lock
+            maxlen=int(cfg.predict_window)
+        )
+        # Cumulative histogram weight last seen per source (id()).
+        self._hist_seen: Dict[int, int] = {}  # guarded-by: self._lock
+        # Previous control-snapshot counters (delta base).
+        self._prev_counters: Dict[str, float] = {}  # guarded-by: self._lock
+        # Ejected corpses: name -> ticks since first seen ejected.
+        self._eject_age: Dict[str, int] = {}  # guarded-by: self._lock
+        # Replicas retired/reaped but not yet closed (engine closes join
+        # worker threads — they run on the CALLER thread, never this one).
+        self._to_close: List[Replica] = []  # guarded-by: self._lock
+        self._last: Dict[str, Any] = {}  # last tick summary  # guarded-by: self._lock
+
+        # Desired fleet size, seeded from what's live right now.
+        snap = router.control_snapshot()
+        live = snap["counts"].get(ADMITTED, 0) + snap["counts"].get(WARMING, 0)
+        self._target = max(  # guarded-by: self._lock
+            cfg.min_replicas, min(cfg.max_replicas, live)
+        )
+        self.metrics.set_gauge("target_replicas", self._target)
+
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------------- loop
+    def start(self) -> "Autopilot":
+        """Launch the pilot thread (idempotent)."""
+        if self._thread is not None:
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hydragnn-pilot", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0, clear_degradation: bool = True) -> None:
+        """Stop the loop, clear any brownout residue, and close every
+        replica the pilot retired (on THIS thread)."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if clear_degradation:
+            self.ladder.reset()
+        self.close_retired()
+
+    def _loop(self) -> None:
+        ctx = telemetry.new_context()
+        telemetry.attach(ctx)
+        while not self._stop_evt.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                telemetry.event("pilot/tick_error", error=repr(e))
+            self._stop_evt.wait(self.config.tick_interval_s)
+
+    # ---------------------------------------------------------------- tick
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One control iteration. ``now`` (monotonic seconds) is injectable
+        so tests and drills can step deterministically."""
+        cfg = self.config
+        t = time.monotonic() if now is None else float(now)
+        snap = self.router.control_snapshot()
+        deltas = self._counter_deltas(snap)
+        rate = self._observe_rate(snap, deltas, t)
+        pressure = self._pressure(snap, deltas)
+
+        actions: List[str] = []
+        spawn_reason: Optional[str] = None
+        with self._lock:
+            live = snap["counts"].get(ADMITTED, 0) + snap["counts"].get(
+                WARMING, 0
+            )
+            target = self._target
+            cooled = (
+                self._last_scale_t is None
+                or (t - self._last_scale_t) >= cfg.cooldown_s
+            )
+
+            # --- reactive arm: hysteresis dead band + cooldown. Capacity
+            # is added on the sustained-entry transition, and again each
+            # cooldown while pressure still sits AT/ABOVE the high
+            # watermark — in the dead band the active state only vetoes
+            # scale-down, it never adds replicas (no creep).
+            trans = self._scale.step(pressure)
+            saturated = trans == "entered" or (
+                self._scale.active and pressure >= cfg.scale_high
+            )
+            if saturated and cooled and target < cfg.max_replicas:
+                target += 1
+                self._last_scale_t = t
+                spawn_reason = "reactive"
+                actions.append("scale_up:reactive")
+            # --- predictive arm: only when the reactive arm is quiet.
+            elif (
+                cfg.predictive
+                and cooled
+                and target < cfg.max_replicas
+                and target > 0
+            ):
+                predicted = self._predicted_rate(cfg)
+                if (
+                    predicted is not None
+                    and predicted > target * cfg.per_replica_rps
+                ):
+                    target += 1
+                    self._last_scale_t = t
+                    spawn_reason = "predictive"
+                    actions.append("scale_up:predictive")
+
+            # --- scale-down: long sustained calm, opposite watermark.
+            if pressure < cfg.scale_low and not self._scale.active:
+                self._under += 1
+            else:
+                self._under = 0
+            if (
+                spawn_reason is None
+                and self._under >= cfg.sustain_down
+                and cooled
+                and target > cfg.min_replicas
+            ):
+                target -= 1
+                self._last_scale_t = t
+                self._under = 0
+                actions.append("scale_down")
+
+            # --- scale-to-zero on sustained idle.
+            idle_now = rate == 0.0 and snap["queue_depth"] == 0
+            self._idle = self._idle + 1 if idle_now else 0
+            if (
+                cfg.idle_ticks_to_zero > 0
+                and self._idle >= cfg.idle_ticks_to_zero
+                and target > 0
+                and cfg.min_replicas == 0
+            ):
+                target = 0
+                self._last_scale_t = t
+                actions.append("scale_to_zero")
+
+            # --- cold wake: fleet at zero but traffic arrived. The failed
+            # request IS the wake signal; bypasses the cooldown.
+            if target == 0 and live == 0 and (
+                deltas.get("failed_total", 0) > 0 or snap["queue_depth"] > 0
+            ):
+                target = 1
+                self._last_scale_t = t
+                self._idle = 0
+                actions.append("cold_wake")
+
+            self._target = target
+            deficit = target - live
+
+        # --- actuate (OUTSIDE self._lock: router calls take the router
+        # lock; keeping the two locks un-nested keeps the order trivial).
+        spawned, retired = self._reconcile(deficit, snap, actions, spawn_reason)
+        reaped = self._reap(snap)
+        bstep = self.ladder.step(pressure)
+        if bstep is not None:
+            actions.append(f"brownout:{bstep}")
+
+        self.metrics.count("ticks_total")
+        self.metrics.set_gauge("target_replicas", target)
+        self.metrics.set_gauge("pressure", pressure)
+        self.metrics.set_gauge("rate_rps", rate)
+        summary = {
+            "ts": t,
+            "pressure": round(pressure, 4),
+            "rate_rps": round(rate, 3),
+            "target": target,
+            "live": live,
+            "actions": actions,
+            "spawned": spawned,
+            "retired": retired,
+            "reaped": reaped,
+            "brownout_level": self.ladder.level,
+            "queue_depth": snap["queue_depth"],
+        }
+        with self._lock:
+            self._last = summary
+        return summary
+
+    # -------------------------------------------------------------- sensing
+    def _counter_deltas(self, snap: Dict[str, Any]) -> Dict[str, float]:
+        """Per-tick deltas of every fleet counter (first tick -> all 0)."""
+        cur = snap["counters"]
+        with self._lock:
+            prev = self._prev_counters
+            self._prev_counters = dict(cur)
+        return {k: v - prev.get(k, v) for k, v in cur.items()}
+
+    def _observe_rate(
+        self, snap: Dict[str, Any], deltas: Dict[str, float], t: float
+    ) -> float:
+        """Demand rate (units/s) this tick: streaming size-histogram weight
+        deltas when sources are wired, else the fleet's own request-counter
+        delta. Appends to the predictive sample window."""
+        with self._lock:
+            last_t = self._last_tick_t
+            self._last_tick_t = t
+        elapsed = (t - last_t) if last_t is not None else None
+
+        total = 0.0
+        have_sources = False
+        for src in self.histogram_sources:
+            have_sources = True
+            doc = (
+                src.histogram_json()
+                if hasattr(src, "histogram_json")
+                else src()
+            )
+            weight = 0
+            for row in doc.get("graph_sizes", ()):
+                weight += int(row[-1])
+            with self._lock:
+                prev = self._hist_seen.get(id(src), 0)
+                self._hist_seen[id(src)] = weight
+            total += max(0, weight - prev)
+        if not have_sources:
+            total = max(0.0, deltas.get("requests_total", 0.0))
+
+        if elapsed is None or elapsed <= 0:
+            return 0.0
+        rate = total / elapsed
+        with self._lock:
+            self._rate_samples.append((t, rate))
+        return rate
+
+    def _predicted_rate(self, cfg: AutopilotConfig) -> Optional[float]:
+        """Least-squares slope over the sample window, projected one
+        spin-up wall (+lead) ahead. None when the window is short, flat,
+        or falling. Caller holds self._lock."""
+        samples = list(self._rate_samples)
+        if len(samples) < max(2, cfg.predict_window // 2):
+            return None
+        t0 = samples[0][0]
+        xs = [s[0] - t0 for s in samples]
+        ys = [s[1] for s in samples]
+        n = float(len(samples))
+        mx = sum(xs) / n
+        my = sum(ys) / n
+        var = sum((x - mx) ** 2 for x in xs)
+        if var <= 0:
+            return None
+        slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / var
+        if slope <= 0:
+            return None
+        horizon = cfg.spinup_wall_s + cfg.predict_lead_s
+        return ys[-1] + slope * horizon
+
+    def _pressure(
+        self, snap: Dict[str, Any], deltas: Dict[str, float]
+    ) -> float:
+        """Scalar fleet pressure: max of (a) in-flight vs capacity, (b)
+        rolling p99 vs the UNDEGRADED class deadline, (c) shed evidence
+        (any admission shed this window means demand already exceeded
+        capacity — floor 1.0 plus the shed fraction)."""
+        cfg = self.config
+        admitted = snap["counts"].get(ADMITTED, 0)
+        inflight = snap["queue_depth"]
+        if admitted == 0:
+            # No capacity at all: saturated if anything wants service.
+            wants = inflight > 0 or deltas.get("failed_total", 0) > 0
+            return cfg.scale_high * 2.0 if wants else 0.0
+        p_queue = inflight / float(admitted * cfg.per_replica_inflight)
+
+        # Undegraded deadlines: the snapshot's deadlines_s are scaled by
+        # the live brownout level — judging recovery against TIGHTENED
+        # deadlines would hold the ladder down forever.
+        scale = snap["degradation"]["deadline_scale"] or 1.0
+        p_lat = 0.0
+        for klass, p99 in snap["fleet_p99_s"].items():
+            dl = snap["deadlines_s"].get(klass)
+            if p99 is None or not dl:
+                continue
+            p_lat = max(p_lat, p99 / (dl / scale))
+
+        shed_d = deltas.get("shed_total", 0.0) - deltas.get(
+            "brownout_shed_total", 0.0
+        )
+        p_shed = 0.0
+        if shed_d > 0:
+            req_d = max(1.0, deltas.get("requests_total", 0.0))
+            p_shed = 1.0 + min(1.0, shed_d / req_d)
+        return max(p_queue, p_lat, p_shed)
+
+    # ------------------------------------------------------------- actuation
+    def _next_name(self) -> str:
+        with self._lock:
+            self._spawned += 1
+            n = self._spawned
+        return f"{self.name_prefix}-{n}"
+
+    def _reconcile(
+        self,
+        deficit: int,
+        snap: Dict[str, Any],
+        actions: List[str],
+        spawn_reason: Optional[str],
+    ) -> Tuple[int, int]:
+        """Drive the live fleet toward the target: spawn on deficit (warm,
+        via the factory), retire the youngest pilot-spawned replicas on
+        surplus."""
+        spawned = retired = 0
+        if deficit > 0:
+            for _ in range(deficit):
+                name = self._next_name()
+                factory = self.factory
+                self.router.scale_up(name, lambda nm=name: factory(nm))
+                spawned += 1
+                self.metrics.count("scale_up_total")
+                if spawn_reason == "predictive":
+                    self.metrics.count("predictive_scale_up_total")
+                if "cold_wake" in actions:
+                    self.metrics.count("cold_wake_total")
+                elif spawn_reason is None and (
+                    snap["counts"].get(EJECTED, 0) > 0
+                    or snap["counts"].get(DRAINING, 0) > 0
+                ):
+                    # Deficit with no scale decision this tick: we are
+                    # replacing a corpse the health loop drained/ejected.
+                    self.metrics.count("replace_total")
+                    actions.append(f"replace:{name}")
+                telemetry.event(
+                    "pilot/spawn", replica=name, reason=spawn_reason or "reconcile"
+                )
+        elif deficit < 0:
+            victims = self._pick_victims(-deficit, snap)
+            for name in victims:
+                if self.router.scale_down(name):
+                    retired += 1
+                    self.metrics.count("scale_down_total")
+                    telemetry.event("pilot/retire", replica=name)
+            if "scale_to_zero" in actions and retired:
+                self.metrics.count("scale_to_zero_total")
+        return spawned, retired
+
+    def _pick_victims(self, n: int, snap: Dict[str, Any]) -> List[str]:
+        """Retire pilot-spawned replicas first (newest first — they carry
+        the least cache warmth seniority), then the lexicographically last
+        of the rest. Only admitted/warming replicas are candidates."""
+        live = [
+            name
+            for name, rec in snap["replicas"].items()
+            if rec["state"] in (ADMITTED, WARMING)
+        ]
+        prefix = f"{self.name_prefix}-"
+
+        def key(name: str) -> Tuple[int, Any]:
+            if name.startswith(prefix):
+                suffix = name[len(prefix):]
+                idx = int(suffix) if suffix.isdigit() else 0
+                return (0, -idx)  # pilot-spawned, newest first
+            return (1, name)
+
+        return sorted(live, key=key)[:n]
+
+    def _reap(self, snap: Dict[str, Any]) -> int:
+        """Collect quiet retiring replicas and over-grace ejected corpses;
+        closes happen later on a caller thread (close_retired)."""
+        cfg = self.config
+        reaped = list(self.router.reap_retired())
+        # Ejected corpses: age them, then remove + queue for close. The
+        # kill-under-autoscale drill's replaced replica exits here.
+        to_remove: List[str] = []
+        with self._lock:
+            seen = set()
+            for name, rec in snap["replicas"].items():
+                if rec["state"] == EJECTED:
+                    seen.add(name)
+                    age = self._eject_age.get(name, 0) + 1
+                    self._eject_age[name] = age
+                    if age >= cfg.eject_grace_ticks:
+                        to_remove.append(name)
+            for name in list(self._eject_age):
+                if name not in seen:
+                    del self._eject_age[name]
+        for name in to_remove:
+            replica = self.router.remove_replica(name)
+            if replica is not None:
+                reaped.append(replica)
+            with self._lock:
+                self._eject_age.pop(name, None)
+            telemetry.event("pilot/reap_ejected", replica=name)
+        if reaped:
+            self.metrics.count("reap_total", len(reaped))
+            with self._lock:
+                self._to_close.extend(reaped)
+        return len(reaped)
+
+    def close_retired(self) -> int:
+        """Close every replica the pilot has collected. MUST run on a
+        caller thread (engine closes join worker threads; running this
+        under the pilot/health tick would self-join)."""
+        with self._lock:
+            batch = self._to_close
+            self._to_close = []
+        for replica in batch:
+            try:
+                replica.close()
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                telemetry.event("pilot/close_error", error=repr(e))
+        return len(batch)
+
+    # -------------------------------------------------------------- reporters
+    @property
+    def target(self) -> int:
+        with self._lock:
+            return self._target
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            last = dict(self._last)
+            target = self._target
+            pending_close = len(self._to_close)
+            scale = {
+                "active": self._scale.active,
+                "enters_total": self._scale.enters_total,
+                "exits_total": self._scale.exits_total,
+            }
+        return {
+            "target": target,
+            "last_tick": last,
+            "scale": scale,
+            "brownout": self.ladder.report(),
+            "bulkheads": self.bulkheads.report() if self.bulkheads else None,
+            "pending_close": pending_close,
+            "metrics": self.metrics.snapshot(),
+            "config": self.config.to_json(),
+        }

@@ -1,0 +1,229 @@
+"""Brownout degradation ladder (docs/SERVING.md "Fleet autopilot";
+ROADMAP item 2).
+
+When the fleet saturates faster than new replicas can spin up, the right
+move is to degrade *gracefully and reversibly* instead of shedding
+indiscriminately. The ladder is an ordered list of degradation steps,
+strictly ranked by severity:
+
+  ``shed_class:<name>``        (severity 1) refuse the named admission
+                               class outright — the reserved lowest-
+                               priority ``ensemble`` tier goes first;
+  ``tighten_deadlines:<f>``    (severity 2) multiply every class's
+                               effective admission deadline by ``f`` in
+                               (0, 1) — the est-wait shed fires earlier;
+  ``shrink_queue:<n>``         (severity 3) hard-cap the router's bounded
+                               in-flight queue at ``n``.
+
+Severity must be non-decreasing along the ladder (graftlint's
+``bad-pilot`` finding rejects unordered ladders): you must not cap the
+whole queue — which sheds the *highest*-priority class — while the
+lowest-priority class is still being admitted.
+
+Each level restates the FULL degradation (the union of steps 1..level)
+through one ``Router.set_degradation`` call, so applying a level is
+idempotent and recovery is exact reversal. Deepen/recover use the same
+dead-band + sustain discipline as the autoscaler's ``Hysteresis``
+(flywheel/drift.py) generalized to multiple levels: pressure must hold
+over the high watermark for ``sustain`` consecutive observations to
+deepen one step, and strictly under the low watermark for ``sustain``
+observations to recover one step; between the watermarks the level holds.
+An oscillating load cannot flap the ladder.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from ..analysis import tsan
+from ..telemetry import graftel as telemetry
+from .metrics import PilotMetrics
+
+# Severity rank per step kind — ladders must be non-decreasing in this
+# rank (checked here AND statically by contracts._check_pilot).
+STEP_SEVERITY: Dict[str, int] = {
+    "shed_class": 1,
+    "tighten_deadlines": 2,
+    "shrink_queue": 3,
+}
+
+LadderSpec = Sequence[Union[str, Tuple[str, object]]]
+
+
+def parse_ladder(spec: LadderSpec) -> List[Tuple[str, object]]:
+    """Parse/validate a ladder spec: ``("shed_class:ensemble",
+    "tighten_deadlines:0.5", "shrink_queue:8")`` (or ``(kind, arg)``
+    pairs). Raises ValueError on empty, unknown-kind, bad-argument, or
+    severity-unordered ladders — the same conditions graftlint flags as
+    ``bad-pilot`` before the process ever starts."""
+    steps: List[Tuple[str, object]] = []
+    for raw in spec:
+        if isinstance(raw, (tuple, list)):
+            if len(raw) != 2:
+                raise ValueError(f"ladder step must be (kind, arg): {raw!r}")
+            kind, arg = str(raw[0]).strip(), raw[1]
+        else:
+            kind, _, arg = str(raw).partition(":")
+            kind = kind.strip()
+        if kind not in STEP_SEVERITY:
+            raise ValueError(
+                f"unknown brownout step kind {kind!r} "
+                f"(known: {sorted(STEP_SEVERITY)})"
+            )
+        if kind == "shed_class":
+            arg = str(arg).strip()
+            if not arg:
+                raise ValueError("shed_class step needs a class name")
+        elif kind == "tighten_deadlines":
+            arg = float(arg)
+            if not (0.0 < arg < 1.0):
+                raise ValueError(
+                    f"tighten_deadlines factor must be in (0, 1), got {arg}"
+                )
+        else:  # shrink_queue
+            arg = int(arg)
+            if arg < 1:
+                raise ValueError(f"shrink_queue cap must be >= 1, got {arg}")
+        steps.append((kind, arg))
+    if not steps:
+        raise ValueError("brownout ladder must not be empty")
+    ranks = [STEP_SEVERITY[k] for k, _ in steps]
+    if ranks != sorted(ranks):
+        raise ValueError(
+            "brownout ladder must be ordered by severity "
+            f"(shed_class < tighten_deadlines < shrink_queue), got {ranks}"
+        )
+    return steps
+
+
+class BrownoutLadder:
+    """Walks a parsed ladder up/down against a pressure signal and applies
+    the cumulative degradation to one router.
+
+    ``step(pressure)`` is called from the autopilot tick (one thread);
+    ``level``/``report`` may be read from anywhere — state sits under an
+    instrumented lock, and the router application happens outside it
+    (``set_degradation`` is an idempotent full-state restatement, so a
+    racing reader of ``level`` can never observe a half-applied rung).
+    """
+
+    def __init__(
+        self,
+        router,
+        steps: LadderSpec,
+        high: float,
+        low: float,
+        sustain: int = 2,
+        metrics: Optional[PilotMetrics] = None,
+    ):
+        if not (0 <= float(low) < float(high)):
+            raise ValueError(
+                f"brownout watermarks need 0 <= low < high, "
+                f"got low={low} high={high}"
+            )
+        if int(sustain) < 1:
+            raise ValueError(f"sustain must be >= 1, got {sustain}")
+        self.router = router
+        self.steps = parse_ladder(steps)
+        self.high = float(high)
+        self.low = float(low)
+        self.sustain = int(sustain)
+        self.metrics = metrics if metrics is not None else PilotMetrics()
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "BrownoutLadder._lock"
+        )
+        self._level = 0  # guarded-by: self._lock
+        self._over = 0  # consecutive obs >= high  # guarded-by: self._lock
+        self._under = 0  # consecutive obs < low  # guarded-by: self._lock
+
+    # ----------------------------------------------------------------- walk
+    def step(self, pressure: float) -> Optional[str]:
+        """Feed one pressure observation; returns "deepened"/"recovered"
+        when the level moved, else None."""
+        changed: Optional[str] = None
+        with self._lock:
+            if pressure >= self.high:
+                self._over += 1
+                self._under = 0
+                if self._over >= self.sustain and self._level < len(self.steps):
+                    self._level += 1
+                    self._over = 0
+                    changed = "deepened"
+            elif pressure < self.low:
+                self._under += 1
+                self._over = 0
+                if self._under >= self.sustain and self._level > 0:
+                    self._level -= 1
+                    self._under = 0
+                    changed = "recovered"
+            else:
+                # Dead band: the level holds, sustain counters reset — an
+                # oscillation between the watermarks cannot flap the ladder.
+                self._over = 0
+                self._under = 0
+            level = self._level
+        if changed is not None:
+            self._apply(level)
+            self.metrics.count(
+                "brownout_step_total"
+                if changed == "deepened"
+                else "brownout_recover_total"
+            )
+            self.metrics.set_gauge("brownout_level", level)
+            telemetry.event(
+                "pilot/brownout",
+                direction=changed,
+                level=level,
+                step=self.steps[level - 1][0] if level else None,
+            )
+        return changed
+
+    def _apply(self, level: int) -> None:
+        """Restate the FULL degradation for steps[:level] (idempotent)."""
+        shed: set = set()
+        scale = 1.0
+        cap: Optional[int] = None
+        for kind, arg in self.steps[:level]:
+            if kind == "shed_class":
+                shed.add(arg)
+            elif kind == "tighten_deadlines":
+                scale *= float(arg)
+            else:  # shrink_queue
+                cap = int(arg) if cap is None else min(cap, int(arg))
+        self.router.set_degradation(
+            shed_classes=shed, deadline_scale=scale, queue_cap=cap
+        )
+
+    def reset(self) -> None:
+        """Clear degradation entirely (autopilot stop path)."""
+        with self._lock:
+            self._level = 0
+            self._over = 0
+            self._under = 0
+        self._apply(0)
+        self.metrics.set_gauge("brownout_level", 0)
+
+    # -------------------------------------------------------------- reporters
+    @property
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def report(self) -> Dict:
+        with self._lock:
+            level = self._level
+            over, under = self._over, self._under
+        return {
+            "level": level,
+            "max_level": len(self.steps),
+            "steps": [
+                {"kind": k, "arg": a, "active": i < level}
+                for i, (k, a) in enumerate(self.steps)
+            ],
+            "high": self.high,
+            "low": self.low,
+            "sustain": self.sustain,
+            "over": over,
+            "under": under,
+        }

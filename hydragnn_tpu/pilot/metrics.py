@@ -1,0 +1,125 @@
+"""Autopilot metrics: the ``hydragnn_pilot_*`` Prometheus family
+(docs/OBSERVABILITY.md "Prometheus catalogue", docs/SERVING.md "Fleet
+autopilot").
+
+Same design as the router's ``RouteMetrics``: host-side, one instrumented
+lock, counters + gauges + a per-tenant table. Observations arrive from the
+``hydragnn-pilot`` control thread (ticks, scale/brownout decisions) and
+from every router caller thread that crosses a tenant bulkhead
+(pilot/tenants.py quota sheds and retry denials) — all fields are declared
+guarded and graftrace-checked.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from ..analysis import tsan
+
+
+class PilotMetrics:
+    """All counters/gauges of one ``Autopilot`` (+ its tenant bulkheads)."""
+
+    _COUNTERS = (
+        "ticks_total",
+        "scale_up_total",
+        "scale_down_total",
+        "predictive_scale_up_total",
+        "cold_wake_total",
+        "scale_to_zero_total",
+        "replace_total",
+        "reap_total",
+        "brownout_step_total",
+        "brownout_recover_total",
+        "tenant_shed_total",
+        "tenant_retry_denied_total",
+    )
+    _GAUGES = (
+        "target_replicas",
+        "brownout_level",
+        "pressure",
+        "rate_rps",
+    )
+
+    def __init__(self):
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "PilotMetrics._lock"
+        )
+        self.ticks_total = 0  # guarded-by: self._lock
+        self.scale_up_total = 0  # guarded-by: self._lock
+        self.scale_down_total = 0  # guarded-by: self._lock
+        self.predictive_scale_up_total = 0  # guarded-by: self._lock
+        self.cold_wake_total = 0  # guarded-by: self._lock
+        self.scale_to_zero_total = 0  # guarded-by: self._lock
+        self.replace_total = 0  # guarded-by: self._lock
+        self.reap_total = 0  # guarded-by: self._lock
+        self.brownout_step_total = 0  # guarded-by: self._lock
+        self.brownout_recover_total = 0  # guarded-by: self._lock
+        self.tenant_shed_total = 0  # guarded-by: self._lock
+        self.tenant_retry_denied_total = 0  # guarded-by: self._lock
+        self.target_replicas = 0.0  # guarded-by: self._lock
+        self.brownout_level = 0.0  # guarded-by: self._lock
+        self.pressure = 0.0  # guarded-by: self._lock
+        self.rate_rps = 0.0  # guarded-by: self._lock
+        # Per tenant: quota sheds + retry denials (the tenant-tagged 429
+        # evidence an operator needs to name the noisy tenant).
+        self._per_tenant: Dict[str, Dict[str, int]] = {}  # guarded-by: self._lock
+
+    # ------------------------------------------------------------- recorders
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+            tsan.shared_access("PilotMetrics.counters")
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            setattr(self, name, float(value))
+
+    def count_tenant(self, tenant: str, which: str, n: int = 1) -> None:
+        with self._lock:
+            entry = self._per_tenant.setdefault(
+                str(tenant), {"shed": 0, "retry_denied": 0}
+            )
+            entry[which] = entry.get(which, 0) + n
+
+    def read_counters(self, *names: str) -> Dict[str, float]:
+        """One locked copy of the named counters/gauges (same torn-pair
+        contract as ServeMetrics/RouteMetrics.read_counters)."""
+        with self._lock:
+            return {n: getattr(self, n) for n in names}
+
+    # -------------------------------------------------------------- reporters
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out: Dict = {n: getattr(self, n) for n in self._COUNTERS}
+            out.update({n: getattr(self, n) for n in self._GAUGES})
+            out["per_tenant"] = {
+                k: dict(v) for k, v in sorted(self._per_tenant.items())
+            }
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition — rides the router /metrics payload
+        when an autopilot is attached."""
+        p = "hydragnn_pilot"
+        snap = self.snapshot()
+        lines = []
+        for name in self._COUNTERS:
+            lines.append(f"# TYPE {p}_{name} counter")
+            lines.append(f"{p}_{name} {snap[name]}")
+        for name in self._GAUGES:
+            lines.append(f"# TYPE {p}_{name} gauge")
+            lines.append(f"{p}_{name} {snap[name]}")
+        lines.append(f"# TYPE {p}_tenant_shed_total counter")
+        for tenant, c in snap["per_tenant"].items():
+            lines.append(
+                f'{p}_tenant_shed_total{{tenant="{tenant}"}} {c["shed"]}'
+            )
+        lines.append(f"# TYPE {p}_tenant_retry_denied_total counter")
+        for tenant, c in snap["per_tenant"].items():
+            lines.append(
+                f'{p}_tenant_retry_denied_total{{tenant="{tenant}"}} '
+                f"{c['retry_denied']}"
+            )
+        return "\n".join(lines) + "\n"

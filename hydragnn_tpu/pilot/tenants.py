@@ -1,0 +1,187 @@
+"""Tenant-isolation bulkheads (docs/SERVING.md "Fleet autopilot";
+ROADMAP item 2).
+
+One misbehaving tenant must not be able to starve the fleet. The bulkhead
+gives every tenant:
+
+  * an **in-flight quota** — at most N requests of THIS tenant inside the
+    router at once; the (N+1)th is shed with a tenant-tagged 429
+    (``TenantQuotaError``) *before* it touches the admission ladder, so it
+    never consumes fleet queue capacity, and
+  * a **retry budget** — a token bucket consulted before every retry hop,
+    so a tenant whose requests keep failing cannot multiply its own load
+    through the router's retry loop (retry storms stay inside the
+    bulkhead).
+
+The router calls ``acquire``/``release`` around each tenant-tagged request
+and ``allow_retry`` before each retry hop (route/router.py). Callers are
+the router's handler threads, so everything here is cross-thread and sits
+under one instrumented lock. Untagged requests (``tenant=None``) bypass
+bulkheads entirely — single-tenant deployments pay nothing.
+
+Quota sheds raise ``TenantQuotaError`` (a ``RouterBusyError``, so HTTP
+clients see an ordinary 429 + Retry-After — just tenant-tagged); the
+router counts them as sheds and the pilot metrics attribute them to the
+tenant by name.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..analysis import tsan
+from ..route.admission import TenantQuotaError
+from ..telemetry import graftel as telemetry
+from .metrics import PilotMetrics
+
+
+class TenantBulkheads:
+    """Per-tenant in-flight quotas + retry budgets.
+
+    ``per_tenant`` overrides the defaults for named tenants:
+    ``{"acme": {"inflight_quota": 16, "retry_budget": 32}}``.
+    """
+
+    def __init__(
+        self,
+        inflight_quota: int = 8,
+        retry_budget: int = 16,
+        retry_refill_per_s: float = 8.0,
+        per_tenant: Optional[Dict[str, Dict[str, float]]] = None,
+        metrics: Optional[PilotMetrics] = None,
+        jitter_seed: Optional[int] = None,
+    ):
+        if int(inflight_quota) < 1:
+            raise ValueError(
+                f"inflight_quota must be >= 1, got {inflight_quota}"
+            )
+        if int(retry_budget) < 0:
+            raise ValueError(f"retry_budget must be >= 0, got {retry_budget}")
+        if float(retry_refill_per_s) < 0:
+            raise ValueError(
+                f"retry_refill_per_s must be >= 0, got {retry_refill_per_s}"
+            )
+        self.inflight_quota = int(inflight_quota)
+        self.retry_budget = int(retry_budget)
+        self.retry_refill_per_s = float(retry_refill_per_s)
+        self.per_tenant = {
+            str(k): dict(v) for k, v in (per_tenant or {}).items()
+        }
+        self.metrics = metrics if metrics is not None else PilotMetrics()
+        self._lock = tsan.instrument_lock(
+            threading.Lock(), "TenantBulkheads._lock"
+        )
+        # Live in-flight count per tenant (router handler threads).
+        self._inflight: Dict[str, int] = {}  # guarded-by: self._lock
+        # Retry token buckets: remaining tokens + last refill stamp.
+        self._retry_tokens: Dict[str, float] = {}  # guarded-by: self._lock
+        self._retry_stamp: Dict[str, float] = {}  # guarded-by: self._lock
+        # Cumulative sheds per tenant (report()/metrics mirror).
+        self._shed: Dict[str, int] = {}  # guarded-by: self._lock
+        self._rng = random.Random(jitter_seed)  # guarded-by: self._lock
+
+    # --------------------------------------------------------------- quotas
+    def quota_for(self, tenant: str) -> Tuple[int, int]:
+        """(inflight_quota, retry_budget) for this tenant (overrides win)."""
+        ov = self.per_tenant.get(tenant, {})
+        return (
+            int(ov.get("inflight_quota", self.inflight_quota)),
+            int(ov.get("retry_budget", self.retry_budget)),
+        )
+
+    def acquire(
+        self, tenant: str, klass: str = "fast", queue_depth: int = 0
+    ) -> None:
+        """Take one in-flight slot for ``tenant`` or shed with a
+        tenant-tagged 429. Every successful acquire MUST be paired with
+        ``release`` (the router does this via try/finally)."""
+        tenant = str(tenant)
+        quota, _ = self.quota_for(tenant)
+        with self._lock:
+            cur = self._inflight.get(tenant, 0)
+            if cur >= quota:
+                self._shed[tenant] = self._shed.get(tenant, 0) + 1
+                # Jittered hint so one tenant's shed clients don't
+                # re-synchronize (same reasoning as admission sheds).
+                hint = 0.05 * (0.5 + self._rng.random())
+            else:
+                self._inflight[tenant] = cur + 1
+                hint = None
+        if hint is not None:
+            self.metrics.count("tenant_shed_total")
+            self.metrics.count_tenant(tenant, "shed")
+            telemetry.event("pilot/tenant_shed", tenant=tenant, klass=klass)
+            raise TenantQuotaError(
+                f"tenant {tenant!r} in-flight quota ({quota}) exhausted "
+                f"(bulkhead; the fleet itself may be healthy)",
+                retry_after_s=hint,
+                tenant=tenant,
+                queue_depth=queue_depth,
+                klass=klass,
+            )
+
+    def release(self, tenant: str) -> None:
+        tenant = str(tenant)
+        with self._lock:
+            cur = self._inflight.get(tenant, 0)
+            if cur <= 1:
+                self._inflight.pop(tenant, None)
+            else:
+                self._inflight[tenant] = cur - 1
+
+    # --------------------------------------------------------- retry budget
+    def allow_retry(self, tenant: str, now: Optional[float] = None) -> bool:
+        """Spend one retry token, or deny. Token bucket: ``retry_budget``
+        capacity refilled at ``retry_refill_per_s`` — a tenant can burst
+        ``retry_budget`` retries, then is held to the refill rate."""
+        tenant = str(tenant)
+        _, budget = self.quota_for(tenant)
+        if budget <= 0:
+            denied = True
+        else:
+            t = time.monotonic() if now is None else float(now)
+            with self._lock:
+                tokens = self._retry_tokens.get(tenant, float(budget))
+                last = self._retry_stamp.get(tenant)
+                if last is not None and t > last:
+                    tokens = min(
+                        float(budget),
+                        tokens + (t - last) * self.retry_refill_per_s,
+                    )
+                self._retry_stamp[tenant] = t
+                if tokens >= 1.0:
+                    self._retry_tokens[tenant] = tokens - 1.0
+                    denied = False
+                else:
+                    self._retry_tokens[tenant] = tokens
+                    denied = True
+        if denied:
+            self.metrics.count("tenant_retry_denied_total")
+            self.metrics.count_tenant(tenant, "retry_denied")
+            telemetry.event("pilot/tenant_retry_denied", tenant=tenant)
+        return not denied
+
+    # -------------------------------------------------------------- reporters
+    def inflight(self, tenant: str) -> int:
+        with self._lock:
+            return self._inflight.get(str(tenant), 0)
+
+    def report(self) -> Dict:
+        with self._lock:
+            inflight = dict(sorted(self._inflight.items()))
+            shed = dict(sorted(self._shed.items()))
+            tokens = {
+                k: round(v, 3)
+                for k, v in sorted(self._retry_tokens.items())
+            }
+        return {
+            "inflight_quota": self.inflight_quota,
+            "retry_budget": self.retry_budget,
+            "retry_refill_per_s": self.retry_refill_per_s,
+            "inflight": inflight,
+            "shed": shed,
+            "retry_tokens": tokens,
+        }

@@ -20,6 +20,7 @@ from .admission import (
     AdmissionClass,
     NoReplicaAvailableError,
     RouterBusyError,
+    TenantQuotaError,
     build_classes,
 )
 from .metrics import RouteMetrics
@@ -52,6 +53,7 @@ __all__ = [
     "Router",
     "RouterBusyError",
     "RouterServer",
+    "TenantQuotaError",
     "build_classes",
     "spawn_serve_replica",
 ]

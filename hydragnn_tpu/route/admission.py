@@ -113,6 +113,30 @@ class RouterBusyError(RuntimeError):
         self.hops = list(hops or [])
 
 
+class TenantQuotaError(RouterBusyError):
+    """A tenant bulkhead shed (pilot/tenants.py): THIS tenant's in-flight
+    quota or retry budget is exhausted — the fleet itself may be healthy.
+    Same retryable-429 contract as :class:`RouterBusyError`, but the shed
+    is tenant-tagged so the front end and the ``hydragnn_pilot_*`` metrics
+    attribute it to the noisy tenant instead of the tier."""
+
+    def __init__(
+        self,
+        message: str,
+        retry_after_s: float,
+        tenant: str,
+        queue_depth: int = 0,
+        klass: str = "fast",
+    ):
+        super().__init__(
+            message,
+            retry_after_s=retry_after_s,
+            queue_depth=queue_depth,
+            klass=klass,
+        )
+        self.tenant = str(tenant)
+
+
 class NoReplicaAvailableError(RuntimeError):
     """Every candidate replica is down/draining — explicit retryable
     failure (HTTP 503 + Retry-After at the front end). Accepted requests

@@ -35,6 +35,8 @@ class RouteMetrics:
         "ejections_total",
         "readmissions_total",
         "warm_admissions_total",
+        "brownout_shed_total",
+        "retired_total",
     )
 
     def __init__(self, class_names: Sequence[str] = ("fast", "ensemble")):
@@ -53,6 +55,8 @@ class RouteMetrics:
         self.ejections_total = 0  # guarded-by: self._lock
         self.readmissions_total = 0  # guarded-by: self._lock
         self.warm_admissions_total = 0  # guarded-by: self._lock
+        self.brownout_shed_total = 0  # guarded-by: self._lock
+        self.retired_total = 0  # guarded-by: self._lock
         # Per admission class: request/shed counters + an e2e latency
         # histogram (the fleet-level p50/p95/p99 the load rig reports).
         self._per_class: Dict[str, Dict[str, int]] = {  # guarded-by: self._lock
@@ -100,6 +104,28 @@ class RouteMetrics:
         ServeMetrics.read_counters)."""
         with self._lock:
             return {n: getattr(self, n) for n in names}
+
+    def control_read(self) -> Dict:
+        """The autopilot's sensor read (Router.control_snapshot's metrics
+        half): EVERY counter plus the per-class request/shed table in ONE
+        locked copy — a control loop diffing a torn counter pair would see
+        phantom shed spikes (the PR-8 scrape bug as a control input) — and
+        each class latency histogram's bounds + bucket counts so the caller
+        can window quantiles by diffing successive snapshots."""
+        with self._lock:
+            counters = {n: getattr(self, n) for n in self._COUNTERS}
+            per_class = {
+                k: dict(v) for k, v in sorted(self._per_class.items())
+            }
+            hists = dict(self.latency)
+        return {
+            "counters": counters,
+            "per_class": per_class,
+            "latency": {
+                k: {"bounds": h.bounds, "counts": h.counts_snapshot()}
+                for k, h in sorted(hists.items())
+            },
+        }
 
     # -------------------------------------------------------------- reporters
     def snapshot(self) -> Dict:

@@ -17,9 +17,13 @@ Replica lifecycle (the health loop's state machine, one poll per
     draining --quiet for readmit_polls--> admitted
     (admitted|draining) --eject_after failed polls--> ejected
     ejected --healthz ok again--> warming   (re-verifies hydration)
+    any --scale_down()--> retiring --in-flight quiet--> reap_retired()
 
 ``draining``/``ejected`` replicas leave the hash ring (no NEW requests;
 in-flight ones finish) but keep being polled so recovery readmits them.
+``retiring`` (graftpilot scale-down) also leaves the ring but takes NO
+health transitions — the autopilot shrank the fleet, the replica is not
+sick — and exits the table only through ``reap_retired()``.
 "degraded counters moved" means the replica's sticky /healthz fault
 counters (bad batches, non-finite outputs, worker restarts) INCREASED
 since the previous poll — the sticky bit alone cannot drive draining or a
@@ -53,10 +57,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ..analysis import tsan
 from ..lifecycle.shadow import ShadowGate, compare_outputs
 from ..telemetry import graftel as telemetry
+from ..serve.metrics import LatencyHistogram
 from .admission import (
     AdmissionClass,
     NoReplicaAvailableError,
     RouterBusyError,
+    TenantQuotaError,
     build_classes,
     jittered,
 )
@@ -72,6 +78,7 @@ WARMING = "warming"
 ADMITTED = "admitted"
 DRAINING = "draining"
 EJECTED = "ejected"
+RETIRING = "retiring"
 
 
 class RouteResult:
@@ -207,6 +214,21 @@ class Router:
         # exclusively under the lock (ring.py is not thread-safe itself).
         self._ring = HashRing(vnodes)  # guarded-by: self._lock, dirty-reads(the attribute cell is bound once here; every mutation and owners() lookup runs under the lock)
         self._inflight_total = 0  # guarded-by: self._lock
+        # Brownout degradation state (graftpilot's ladder actuates it via
+        # set_degradation; _admit consults it): classes shed outright, the
+        # factor per-class deadlines are tightened by, and the hard
+        # in-flight cap ("shrink the bounded queue"). Every step is
+        # reversible; (set(), 1.0, None) is the healthy level-0 state.
+        self._deg_shed: set = set()  # guarded-by: self._lock
+        self._deg_deadline_scale = 1.0  # guarded-by: self._lock
+        self._deg_queue_cap: Optional[int] = None  # guarded-by: self._lock
+        # Tenant bulkheads (pilot/tenants.py duck type: acquire/release/
+        # allow_retry) — None until an autopilot attaches them.
+        self._bulkheads: Optional[Any] = None  # guarded-by: self._lock
+        # Per-class latency bucket counts at the PREVIOUS control_snapshot
+        # — the rolling fleet-p99 window anchor (deltas between successive
+        # snapshots are the window).
+        self._ctl_hist_seen: Dict[str, List[int]] = {}  # guarded-by: self._lock
         # Retry-jitter stream; Random() is internally locked, the seed makes
         # shed hints reproducible in tests.
         self._rng = random.Random(jitter_seed)
@@ -492,6 +514,166 @@ class Router:
         self.metrics.set_replica_state(name, None)
         return ent.replica if ent is not None else None
 
+    def scale_down(self, name: str) -> bool:
+        """Graceful scale-down (graftpilot's drain actuator): ``retiring``
+        leaves the ring immediately (no NEW requests; in-flight dispatches
+        finish) and the entry exits the table only through
+        :meth:`reap_retired` once quiet. Unlike ``draining``, a retiring
+        replica is never readmitted by the health loop — the autopilot
+        decided the fleet is too big, not that the replica is sick.
+        Returns False for an unknown or already-retiring name."""
+        with self._lock:
+            ent = self._table.get(name)
+            if ent is None or ent.state == RETIRING:
+                return False
+            ent.state = RETIRING
+            self._ring.remove(name)
+        self.metrics.set_replica_state(name, RETIRING)
+        telemetry.event("route/replica_retire", replica=name)
+        return True
+
+    def reap_retired(self) -> List[Replica]:
+        """Pop retiring replicas whose in-flight count reached zero and
+        return them — the CALLER closes them (an engine close joins worker
+        threads; it must not run under the health or pilot loop's tick)."""
+        popped: List[Tuple[str, Optional[Replica]]] = []
+        with self._lock:
+            quiet = [
+                n
+                for n, e in self._table.items()
+                if e.state == RETIRING and e.inflight == 0
+            ]
+            for name in quiet:
+                ent = self._table.pop(name)
+                popped.append((name, ent.replica))
+        out: List[Replica] = []
+        for name, replica in popped:
+            self.metrics.set_replica_state(name, None)
+            self.metrics.count("retired_total")
+            telemetry.event("route/replica_retired", replica=name)
+            if replica is not None:
+                out.append(replica)
+        return out
+
+    # ------------------------------------------------------- pilot actuators
+    def set_degradation(
+        self,
+        shed_classes: Sequence[str] = (),
+        deadline_scale: float = 1.0,
+        queue_cap: Optional[int] = None,
+    ) -> None:
+        """Install the FULL brownout state for one ladder level
+        (pilot/brownout.py): each level re-states everything, so the walk
+        is idempotent and a crashed recovery cannot leave stale residue.
+        ``shed_classes`` are refused outright at admission;
+        ``deadline_scale`` in (0, 1] multiplies every class deadline in the
+        admission estimate; ``queue_cap`` bounds the router-level in-flight
+        count. Validation mirrors the static ``bad-pilot`` checks."""
+        scale = float(deadline_scale)
+        if not (0.0 < scale <= 1.0) or not math.isfinite(scale):
+            raise ValueError(
+                f"deadline_scale must be in (0, 1], got {deadline_scale!r}"
+            )
+        cap = None if queue_cap is None else int(queue_cap)
+        if cap is not None and cap < 1:
+            raise ValueError(f"queue_cap must be >= 1 or None, got {cap}")
+        shed = {str(c) for c in shed_classes}
+        unknown = shed - set(self.classes)
+        if unknown:
+            raise ValueError(
+                f"cannot shed unknown admission classes {sorted(unknown)}; "
+                f"configured: {sorted(self.classes)}"
+            )
+        with self._lock:
+            self._deg_shed = shed
+            self._deg_deadline_scale = scale
+            self._deg_queue_cap = cap
+        telemetry.event(
+            "route/degradation",
+            shed=sorted(shed),
+            deadline_scale=scale,
+            queue_cap=cap,
+        )
+
+    def set_bulkheads(self, bulkheads: Optional[Any]) -> None:
+        """Attach (or detach, with None) the tenant bulkheads every
+        tenant-tagged ``predict`` consults (pilot/tenants.py)."""
+        with self._lock:
+            self._bulkheads = bulkheads
+
+    def control_snapshot(self) -> Dict[str, Any]:
+        """The autopilot's ONE sensor read: queue depth, per-replica
+        lifecycle state, per-class request/shed counters, rolling fleet
+        p99, and the live degradation state — two internally-consistent
+        locked copies (the routing table + degradation under this router's
+        lock, every counter family in RouteMetrics.control_read's single
+        locked pass) instead of the scattered ``metrics.snapshot()`` /
+        ``/healthz`` / telemetry reads a control loop would otherwise tear
+        (the PR-8 torn-counter-pair reasoning, now as a control input).
+
+        ``fleet_p99_s`` is ROLLING: per class, the interpolated p99 of the
+        latency observations recorded since the PREVIOUS control_snapshot
+        call (bucket-count deltas), None for a window with no completions —
+        a cumulative p99 would stay pinned high long after a wave passed
+        and hold the brownout ladder down."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = {
+                name: {
+                    "state": ent.state,
+                    "inflight": ent.inflight,
+                    "fails": ent.fails,
+                    "spawn_wall_s": ent.spawn_wall_s,
+                    "queue_depth": int(
+                        (ent.last_health or {}).get("queue_depth") or 0
+                    ),
+                }
+                for name, ent in sorted(self._table.items())
+            }
+            inflight = self._inflight_total
+            degradation = {
+                "shed_classes": sorted(self._deg_shed),
+                "deadline_scale": self._deg_deadline_scale,
+                "queue_cap": self._deg_queue_cap,
+            }
+        m = self.metrics.control_read()
+        with self._lock:
+            prev = self._ctl_hist_seen
+            self._ctl_hist_seen = {
+                k: list(v["counts"]) for k, v in m["latency"].items()
+            }
+        p99: Dict[str, Optional[float]] = {}
+        for k, v in m["latency"].items():
+            base = prev.get(k, [0] * len(v["counts"]))
+            delta = [c - p for c, p in zip(v["counts"], base)]
+            if any(d < 0 for d in delta):
+                delta = list(v["counts"])  # histogram replaced: full window
+            p99[k] = LatencyHistogram.quantile_of(v["bounds"], delta, 0.99)
+        counts: Dict[str, int] = {
+            s: 0 for s in (WARMING, ADMITTED, DRAINING, EJECTED, RETIRING)
+        }
+        spawn_walls = []
+        for rec in replicas.values():
+            counts[rec["state"]] = counts.get(rec["state"], 0) + 1
+            if rec["spawn_wall_s"] is not None:
+                spawn_walls.append(rec["spawn_wall_s"])
+        scale = degradation["deadline_scale"]
+        return {
+            "ts_monotonic": now,
+            "queue_depth": inflight,
+            "replicas": replicas,
+            "counts": counts,
+            "counters": m["counters"],
+            "per_class": m["per_class"],
+            "fleet_p99_s": p99,
+            "deadlines_s": {
+                name: ac.deadline_s * scale
+                for name, ac in sorted(self.classes.items())
+            },
+            "max_spawn_wall_s": max(spawn_walls) if spawn_walls else None,
+            "degradation": degradation,
+        }
+
     def start_health_loop(self) -> None:
         """Launch the health-poll thread (idempotent)."""
         if self._health_thread is not None:
@@ -575,14 +757,20 @@ class Router:
         klass: Optional[str] = None,
         timeout: Optional[float] = None,
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> RouteResult:
         """Route one prediction call. Admission against the class deadline,
         consistent-hash primary + bounded-load spill, retry on shed/down
         replicas while the deadline allows. ``klass=None`` takes
-        :attr:`default_class`. Raises :class:`RouterBusyError` (shed,
-        retryable, jittered hint), :class:`NoReplicaAvailableError` (no
-        serving replica, retryable), or propagates per-request errors
-        (ValueError, TimeoutError)."""
+        :attr:`default_class`. ``tenant`` names the calling tenant's
+        bulkhead namespace (pilot/tenants.py): the consistent-hash walk is
+        keyed per tenant, the tenant's in-flight quota is charged for the
+        call's duration, and each retry hop spends the tenant's retry
+        budget. Raises :class:`RouterBusyError` (shed, retryable, jittered
+        hint; :class:`TenantQuotaError` when the tenant's own bulkhead
+        shed), :class:`NoReplicaAvailableError` (no serving replica,
+        retryable), or propagates per-request errors (ValueError,
+        TimeoutError)."""
         if klass is None:
             klass = self.default_class
         ac = self.classes.get(klass)
@@ -599,7 +787,42 @@ class Router:
         deadline = t0 + ac.deadline_s
         self.metrics.count("requests_total")
         self.metrics.count_class(klass, "requests")
+        with self._lock:
+            bulkheads = self._bulkheads if tenant is not None else None
+        if bulkheads is not None:
+            try:
+                bulkheads.acquire(
+                    tenant, klass=klass, queue_depth=self.queue_depth()
+                )
+            except TenantQuotaError:
+                self.metrics.count("shed_total")
+                self.metrics.count_class(klass, "shed")
+                telemetry.event(
+                    "route/shed",
+                    request_id=rid,
+                    klass=klass,
+                    reason="tenant_quota",
+                    tenant=tenant,
+                )
+                raise
+        try:
+            return self._predict_admitted(
+                samples, ac, klass, rid, t0, deadline, hop_timeout,
+                tenant, bulkheads,
+            )
+        finally:
+            if bulkheads is not None:
+                bulkheads.release(tenant)
+
+    def _predict_admitted(
+        self, samples, ac, klass, rid, t0, deadline, hop_timeout,
+        tenant, bulkheads,
+    ) -> RouteResult:
         self._admit(ac, rid)
+        # Per-tenant ring namespace: each tenant gets its own stable walk
+        # over the SAME members, so one tenant's hot keys do not define
+        # another tenant's primaries.
+        ring_key = f"{tenant}/{rid}" if tenant is not None else rid
 
         hops: List[dict] = []
         tried: set = set()
@@ -608,7 +831,19 @@ class Router:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
                 break
-            target = self._acquire_target(rid, tried)
+            if hops and bulkheads is not None and not bulkheads.allow_retry(
+                tenant
+            ):
+                # Retry budget spent: a tenant whose oversize graphs keep
+                # bouncing off replicas must not consume the whole fleet's
+                # hop capacity — fail over to the explicit shed below.
+                telemetry.event(
+                    "route/retry_budget_exhausted",
+                    request_id=rid,
+                    tenant=tenant,
+                )
+                break
+            target = self._acquire_target(ring_key, tried)
             if target is None:
                 break
             name, replica, spilled = target
@@ -739,12 +974,33 @@ class Router:
     def _admit(self, ac: AdmissionClass, rid: str) -> None:
         """Deadline-based admission: estimated fleet wait (in-flight per
         admitted replica x observed per-request seconds) vs the class
-        deadline. The generalization of the engine's single-queue 429."""
+        deadline. The generalization of the engine's single-queue 429.
+        The brownout degradation state (set_degradation) is consulted here
+        too: shed classes are refused outright, deadlines are tightened by
+        the scale factor, and the queue cap bounds total in-flight."""
         with self._lock:
             admitted = sum(
                 1 for e in self._table.values() if e.state == ADMITTED
             )
             inflight = self._inflight_total
+            deg_shed = set(self._deg_shed)
+            deg_scale = self._deg_deadline_scale
+            deg_cap = self._deg_queue_cap
+        if ac.name in deg_shed:
+            self.metrics.count("shed_total")
+            self.metrics.count_class(ac.name, "shed")
+            self.metrics.count("brownout_shed_total")
+            hint = jittered(self.health_interval_s * 4.0, self._rng)
+            telemetry.event(
+                "route/shed", request_id=rid, klass=ac.name, reason="brownout"
+            )
+            raise RouterBusyError(
+                f"brownout: the {ac.name!r} class is temporarily shed "
+                f"(degradation ladder); retry in ~{hint:.2f}s",
+                retry_after_s=hint,
+                queue_depth=inflight,
+                klass=ac.name,
+            )
         if admitted == 0:
             self.metrics.count("failed_total")
             hint = jittered(self.health_interval_s * 2.0, self._rng)
@@ -756,32 +1012,54 @@ class Router:
                 f"retry in ~{hint:.2f}s",
                 retry_after_s=hint,
             )
+        if deg_cap is not None and inflight >= deg_cap:
+            self.metrics.count("shed_total")
+            self.metrics.count_class(ac.name, "shed")
+            self.metrics.count("brownout_shed_total")
+            hint = jittered(self.health_interval_s * 4.0, self._rng)
+            telemetry.event(
+                "route/shed", request_id=rid, klass=ac.name, reason="queue_cap"
+            )
+            raise RouterBusyError(
+                f"brownout: router queue capped at {deg_cap} in-flight "
+                f"({inflight} outstanding); retry in ~{hint:.2f}s",
+                retry_after_s=hint,
+                queue_depth=inflight,
+                klass=ac.name,
+            )
         hist = self.metrics.latency.get(ac.name)
         mean = hist.mean() if hist is not None else None
         per_req = mean if mean is not None else 0.05
         est_wait = (inflight / admitted) * per_req
-        if est_wait > ac.deadline_s:
+        deadline_eff = ac.deadline_s * deg_scale
+        if est_wait > deadline_eff:
             self.metrics.count("shed_total")
             self.metrics.count_class(ac.name, "shed")
             hint = jittered(est_wait, self._rng)
             telemetry.event(
                 "route/shed", request_id=rid, klass=ac.name, reason="admission"
             )
+            tightened = (
+                f" (tightened x{deg_scale:g} by the brownout ladder)"
+                if deg_scale < 1.0
+                else ""
+            )
             raise RouterBusyError(
                 f"estimated fleet wait {est_wait:.2f}s exceeds the "
-                f"{ac.name!r} deadline {ac.deadline_s:g}s; retry in "
-                f"~{hint:.2f}s",
+                f"{ac.name!r} deadline {deadline_eff:g}s{tightened}; retry "
+                f"in ~{hint:.2f}s",
                 retry_after_s=hint,
                 queue_depth=inflight,
                 klass=ac.name,
             )
 
     def _acquire_target(
-        self, rid: str, tried: set
+        self, ring_key: str, tried: set
     ) -> Optional[Tuple[str, Replica, bool]]:
         """Pick the next candidate under the lock: ring owners in walk
-        order, skipping tried/non-admitted replicas, spilling past owners
-        over the bounded-load limit; increments the in-flight counters."""
+        order (keyed per tenant when the request is tenant-tagged),
+        skipping tried/non-admitted replicas, spilling past owners over
+        the bounded-load limit; increments the in-flight counters."""
         with self._lock:
             admitted = sum(
                 1 for e in self._table.values() if e.state == ADMITTED
@@ -790,7 +1068,7 @@ class Router:
                 return None
             cands = [
                 n
-                for n in self._ring.owners(rid)
+                for n in self._ring.owners(ring_key)
                 if n not in tried
                 and self._table[n].state == ADMITTED
             ]
@@ -879,6 +1157,11 @@ class Router:
             if ent is None:
                 return
             ent.last_health = h
+            if ent.state == RETIRING:
+                # Retiring replicas take no health transitions: not
+                # ejectable (already leaving), never readmitted —
+                # reap_retired() is the only exit from the table.
+                return
             if not ok:
                 ent.fails += 1
                 # WARMING ejects too: a scale-up target whose health

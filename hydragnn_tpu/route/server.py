@@ -104,6 +104,12 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
                 klass = self.router.default_class
             if not isinstance(klass, str):
                 raise ValueError('"class" must be an admission-class name')
+            # Optional tenant tag: routes into the tenant's bulkhead
+            # namespace when an autopilot attached one (pilot/tenants.py);
+            # ignored by a router with no bulkheads.
+            tenant = doc.get("tenant")
+            if tenant is not None and not isinstance(tenant, str):
+                raise ValueError('"tenant" must be a string tenant name')
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             self._send_json(400, {"error": str(e), "request_id": rid})
             return
@@ -115,8 +121,11 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
                 klass=klass,
                 timeout=getattr(self.server, "request_timeout_s", 60.0),
                 request_id=rid,
+                tenant=tenant,
             )
         except RouterBusyError as e:
+            # Tenant-tagged 429 (TenantQuotaError): the shed names the
+            # noisy tenant so clients/operators can attribute it.
             self._send_json(
                 429,
                 {
@@ -125,6 +134,7 @@ class _Handler(RequestPlumbing, BaseHTTPRequestHandler):
                     "replica_retry_after_s": e.replica_retry_after_s,
                     "queue_depth": e.queue_depth,
                     "class": e.klass,
+                    "tenant": getattr(e, "tenant", None),
                     "hops": e.hops,
                     "request_id": rid,
                 },

@@ -70,8 +70,19 @@ class LatencyHistogram:
     def quantile(self, q: float) -> Optional[float]:
         """Interpolated q-quantile in seconds (None when empty)."""
         with self._lock:
-            total = self.count
             counts = list(self._counts)
+        return self.quantile_of(self.bounds, counts, q)
+
+    @staticmethod
+    def quantile_of(
+        bounds: Sequence[float], counts: Sequence[int], q: float
+    ) -> Optional[float]:
+        """Interpolated q-quantile of an explicit per-bucket count vector
+        (None when empty). Exposed so windowed readers — the router's
+        rolling fleet-p99 sensor diffs successive ``counts_snapshot``
+        vectors — estimate quantiles of a DELTA distribution with the same
+        interpolation the cumulative :meth:`quantile` uses."""
+        total = sum(counts)
         if total == 0:
             return None
         rank = q * total
@@ -80,15 +91,17 @@ class LatencyHistogram:
             prev_cum = cum
             cum += c
             if cum >= rank and c > 0:
-                hi = (
-                    self.bounds[i]
-                    if i < len(self.bounds)
-                    else self.bounds[-1] * 2.0
-                )
-                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = bounds[i] if i < len(bounds) else bounds[-1] * 2.0
+                lo = bounds[i - 1] if i > 0 else 0.0
                 frac = (rank - prev_cum) / c
                 return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-        return self.bounds[-1] * 2.0
+        return bounds[-1] * 2.0
+
+    def counts_snapshot(self) -> List[int]:
+        """One locked copy of the per-bucket counts (len(bounds) + 1 with
+        the overflow bucket last) — the windowed-quantile reader's input."""
+        with self._lock:
+            return list(self._counts)
 
     def snapshot(self) -> Dict[str, Optional[float]]:
         with self._lock:

@@ -308,6 +308,66 @@ def pytest_router_config_findings():
     )
 
 
+def pytest_pilot_config_findings():
+    """graftpilot config contract (ISSUE 20): inverted/degenerate
+    watermarks, cooldown shorter than the spin-up wall, empty/unordered
+    brownout ladders, per-tenant quota wider than the global bound, and
+    min > max replicas are ``bad-pilot`` findings through the same
+    gate_config path — and everything the gate rejects, the
+    ``AutopilotConfig`` constructor rejects at runtime too."""
+    from hydragnn_tpu.pilot import AutopilotConfig
+
+    def codes(pilot):
+        try:
+            check_config(
+                _base(), mode="serving", deep=False, pilot=pilot
+            )
+        except ConfigContractError as e:
+            return [c for c, _ in e.errors]
+        return []
+
+    # Inverted / degenerate / non-numeric watermark pairs (both arms).
+    assert "bad-pilot" in codes({"scale_low": 0.9, "scale_high": 0.3})
+    assert "bad-pilot" in codes({"scale_low": 0.5, "scale_high": 0.5})
+    assert "bad-pilot" in codes({"scale_low": -0.1, "scale_high": 0.8})
+    assert "bad-pilot" in codes({"scale_low": "low", "scale_high": 0.8})
+    assert "bad-pilot" in codes({"brownout_low": 2.0, "brownout_high": 1.0})
+    # Cooldown that cannot cover the measured spin-up wall.
+    assert "bad-pilot" in codes({"cooldown_s": 1.0, "spinup_wall_s": 5.0})
+    # Brownout-ladder nonsense: empty, unknown step, severity-unordered
+    # (capping the queue sheds the HIGHEST-priority class — it must never
+    # precede shedding the lowest).
+    assert "bad-pilot" in codes({"ladder": []})
+    assert "bad-pilot" in codes({"ladder": ["drop_everything:now"]})
+    assert "bad-pilot" in codes(
+        {"ladder": ["shrink_queue:8", "shed_class:ensemble"]}
+    )
+    assert "bad-pilot" in codes({"ladder": ["tighten_deadlines:1.5"]})
+    # One tenant's bulkhead wider than the whole fleet = no bulkhead.
+    assert "bad-pilot" in codes(
+        {"tenant_inflight_quota": 128, "global_inflight_limit": 64}
+    )
+    # Replica-bound nonsense.
+    assert "bad-pilot" in codes({"min_replicas": 4, "max_replicas": 2})
+    assert "bad-pilot" in codes({"min_replicas": -1})
+    assert "bad-pilot" in codes({"max_replicas": 0})
+    assert "bad-pilot" in codes({"idle_ticks_to_zero": 5, "min_replicas": 1})
+    # A sane autopilot config contributes no pilot findings — and the
+    # defaults themselves must pass their own gate.
+    assert "bad-pilot" not in codes(AutopilotConfig().to_json())
+    # Runtime mirror: the same rejections raise in the constructor.
+    with pytest.raises(ValueError):
+        AutopilotConfig(scale_low=0.9, scale_high=0.3)
+    with pytest.raises(ValueError):
+        AutopilotConfig(cooldown_s=1.0, spinup_wall_s=5.0)
+    with pytest.raises(ValueError):
+        AutopilotConfig(ladder=("shrink_queue:8", "shed_class:ensemble"))
+    with pytest.raises(ValueError):
+        AutopilotConfig(tenant_inflight_quota=128, global_inflight_limit=64)
+    with pytest.raises(ValueError):
+        AutopilotConfig(min_replicas=4, max_replicas=2)
+
+
 def pytest_rejects_bad_mesh():
     """graftmesh config contract (docs/DISTRIBUTED.md): unknown grad_sync
     arm, non-positive bucket size, graph_axis with the CSR/sorted contract

@@ -1,0 +1,618 @@
+"""graftpilot (hydragnn_tpu/pilot/) — the fleet autopilot.
+
+Covers the ISSUE-20 contract: hysteresis no-flap under oscillating load,
+the predictive arm scaling BEFORE a replayed demand wave saturates the
+fleet, the brownout ladder shedding strictly in severity order and
+recovering in exact reverse, tenant bulkheads isolating a noisy tenant
+(the victim still completes inside its SLO), scale-to-zero followed by a
+warm cold-wake with a zero-XLA-compile spy on the shared graftcache
+store, and kill-a-replica-under-autoscale with zero lost accepted
+requests. Control-logic tests run against a scriptable fake router
+(deterministic injected clocks, no jax); the cold-wake test uses real
+engines. Tier-1, CPU.
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hydragnn_tpu.flywheel import Hysteresis
+from hydragnn_tpu.pilot import (
+    Autopilot,
+    AutopilotConfig,
+    TenantBulkheads,
+    parse_ladder,
+)
+from hydragnn_tpu.route import Router, TenantQuotaError
+from hydragnn_tpu.route.metrics import RouteMetrics
+from hydragnn_tpu.route.replica import ReplicaDownError
+
+_STATES = ("warming", "admitted", "draining", "ejected", "retiring")
+
+
+# ---------------------------------------------------------------- fixtures
+class _FakePilotRouter:
+    """Scriptable control-plane double: the autopilot touches only the
+    sensor/actuator surface (control_snapshot / scale_up / scale_down /
+    reap_retired / remove_replica / set_degradation / set_bulkheads), so
+    the control logic is testable with injected pressure and clocks."""
+
+    def __init__(self, replicas=("r0",), deadlines=None):
+        self.replicas = {
+            n: {
+                "state": "admitted",
+                "inflight": 0,
+                "fails": 0,
+                "spawn_wall_s": 0.1,
+                "queue_depth": 0,
+            }
+            for n in replicas
+        }
+        self.queue = 0
+        self.counters = {n: 0 for n in RouteMetrics._COUNTERS}
+        self.p99 = {}
+        self.deadlines = dict(deadlines or {"fast": 2.0, "ensemble": 15.0})
+        self.degradation = {
+            "shed_classes": [],
+            "deadline_scale": 1.0,
+            "queue_cap": None,
+        }
+        self.deg_calls = []
+        self.scale_ups = []
+        self.scale_downs = []
+        self.bulkheads = None
+
+    def control_snapshot(self):
+        counts = {s: 0 for s in _STATES}
+        for rec in self.replicas.values():
+            counts[rec["state"]] += 1
+        scale = self.degradation["deadline_scale"]
+        return {
+            "ts_monotonic": 0.0,
+            "queue_depth": self.queue,
+            "replicas": {k: dict(v) for k, v in sorted(self.replicas.items())},
+            "counts": counts,
+            "counters": dict(self.counters),
+            "per_class": {},
+            "fleet_p99_s": dict(self.p99),
+            "deadlines_s": {k: v * scale for k, v in self.deadlines.items()},
+            "max_spawn_wall_s": 0.1,
+            "degradation": {
+                "shed_classes": list(self.degradation["shed_classes"]),
+                "deadline_scale": scale,
+                "queue_cap": self.degradation["queue_cap"],
+            },
+        }
+
+    def scale_up(self, name, factory, weight=1.0, expected_rungs=None):
+        self.scale_ups.append(name)
+        # Admit instantly: control-logic tests exercise decisions, not
+        # the (separately tested) warm spin-up machinery.
+        self.replicas[name] = {
+            "state": "admitted",
+            "inflight": 0,
+            "fails": 0,
+            "spawn_wall_s": 0.1,
+            "queue_depth": 0,
+        }
+
+        class _T:
+            def join(self, *a):
+                pass
+
+        return _T()
+
+    def scale_down(self, name):
+        ent = self.replicas.get(name)
+        if ent is None or ent["state"] == "retiring":
+            return False
+        ent["state"] = "retiring"
+        self.scale_downs.append(name)
+        return True
+
+    def reap_retired(self):
+        quiet = [
+            n
+            for n, r in self.replicas.items()
+            if r["state"] == "retiring" and r["inflight"] == 0
+        ]
+        for n in quiet:
+            del self.replicas[n]
+        return []
+
+    def remove_replica(self, name):
+        self.replicas.pop(name, None)
+        return None
+
+    def set_degradation(self, shed_classes=(), deadline_scale=1.0, queue_cap=None):
+        self.degradation = {
+            "shed_classes": sorted(shed_classes),
+            "deadline_scale": deadline_scale,
+            "queue_cap": queue_cap,
+        }
+        self.deg_calls.append(
+            (tuple(sorted(shed_classes)), deadline_scale, queue_cap)
+        )
+
+    def set_bulkheads(self, bulkheads):
+        self.bulkheads = bulkheads
+
+
+class _StubReplica:
+    """Scriptable replica for real-router pilot tests (no engine, no jax)."""
+
+    def __init__(self, name, block=None):
+        self.name = name
+        self.health_doc = {"ok": True, "compiled_buckets": 1}
+        self.health_exc = None
+        self.predict_exc = None
+        self.block = block
+        self.closed = False
+
+    def predict(self, samples, timeout=60.0, request_id=None):
+        if self.block is not None:
+            self.block.wait(10)
+        if self.predict_exc is not None:
+            raise self.predict_exc
+        return [[np.zeros(1, np.float32)] for _ in samples]
+
+    def health(self):
+        if self.health_exc is not None:
+            raise self.health_exc
+        return dict(self.health_doc)
+
+    def close(self):
+        self.closed = True
+
+
+def _pilot(router, cfg, **kw):
+    return Autopilot(router, lambda name: _StubReplica(name), cfg, **kw)
+
+
+# --------------------------------------------------- 1. hysteresis no-flap
+def pytest_hysteresis_no_flap_under_oscillating_load():
+    # The shared dead-band machine itself (flywheel/drift.py): entry needs
+    # sustained over-high, exit needs strictly under-low, and the band
+    # between the watermarks never transitions.
+    h = Hysteresis(0.8, 0.3, sustain=2)
+    assert [h.step(v) for v in (0.9, 0.9)] == [None, "entered"]
+    # Oscillation inside the dead band holds the active state.
+    assert [h.step(v) for v in (0.5, 0.79, 0.31, 0.5)] == [None] * 4
+    assert h.active
+    assert h.step(0.2) == "exited"
+    # One blip over high does not re-enter (sustain resets on the dip).
+    assert [h.step(v) for v in (0.9, 0.5, 0.9)] == [None, None, None]
+    assert h.enters_total == 1 and h.exits_total == 1
+
+    # The autopilot on top of it: offered load oscillating between the
+    # watermarks must produce ZERO scale actions over a long horizon.
+    fake = _FakePilotRouter()
+    cfg = AutopilotConfig(
+        scale_high=0.8,
+        scale_low=0.3,
+        sustain_up=2,
+        sustain_down=8,
+        cooldown_s=1.0,
+        spinup_wall_s=0.5,
+        min_replicas=1,
+        max_replicas=4,
+        per_replica_inflight=4,
+        predictive=False,
+    )
+    ap = _pilot(fake, cfg)
+    for i in range(40):
+        fake.queue = 2 if i % 2 else 3  # pressure 0.5 / 0.75: in the band
+        ap.tick(now=float(i))
+    assert fake.scale_ups == [] and fake.scale_downs == []
+    assert ap.target == 1
+
+    # Sustained saturation DOES scale (pressure 1.5 for sustain_up ticks)…
+    fake.queue = 6
+    summaries = [ap.tick(now=40.0 + i) for i in range(2)]
+    assert fake.scale_ups == ["pilot-1"]
+    assert any("scale_up:reactive" in s["actions"] for s in summaries)
+    # …and the new capacity pulls pressure back into the band: no flap.
+    for i in range(10):
+        ap.tick(now=43.0 + i)
+    assert fake.scale_ups == ["pilot-1"] and fake.scale_downs == []
+
+    # Sustained calm under the low watermark walks back down exactly once
+    # per sustain_down window — and never below min_replicas.
+    fake.queue = 0
+    for i in range(30):
+        ap.tick(now=60.0 + i)
+    assert fake.scale_downs == ["pilot-1"]
+    assert ap.target == 1
+
+
+# ----------------------------------------------- 2. predictive arm (waves)
+def pytest_predictive_arm_scales_before_replayed_wave():
+    """Replay a rising diurnal ramp through a streaming size-histogram
+    source: the predictive arm must add capacity while the CURRENT rate is
+    still under fleet capacity (i.e. before the reactive arm has anything
+    to react to)."""
+
+    class _Source:
+        def __init__(self):
+            self.weight = 0
+
+        def histogram_json(self):
+            return {"graph_sizes": [[32, 128, self.weight]]}
+
+    src = _Source()
+    fake = _FakePilotRouter()
+    cfg = AutopilotConfig(
+        scale_high=0.8,
+        scale_low=0.3,
+        cooldown_s=5.0,
+        spinup_wall_s=4.0,
+        predict_lead_s=1.0,
+        predict_window=8,
+        per_replica_rps=20.0,
+        min_replicas=1,
+        max_replicas=4,
+    )
+    ap = _pilot(fake, cfg, histogram_sources=[src])
+    fired_at_rate = None
+    cum = 0
+    for i in range(12):
+        cum += 2 * i  # demand rate ramps 0, 2, 4, ... units/s
+        src.weight = cum
+        s = ap.tick(now=float(i))
+        if "scale_up:predictive" in s["actions"]:
+            fired_at_rate = s["rate_rps"]
+            break
+    assert fired_at_rate is not None, "predictive arm never fired"
+    # Scaled BEFORE the wave: current rate still under one replica's
+    # capacity, queue empty — the reactive arm had no signal at all.
+    assert fired_at_rate < cfg.per_replica_rps
+    assert fake.queue == 0
+    assert fake.scale_ups == ["pilot-1"]
+    counters = ap.metrics.read_counters(
+        "predictive_scale_up_total", "scale_up_total"
+    )
+    assert counters["predictive_scale_up_total"] == 1
+    assert counters["scale_up_total"] == 1
+    # A flat replay (slope 0) never fires predictively.
+    fake2 = _FakePilotRouter()
+    src2 = _Source()
+    ap2 = _pilot(fake2, cfg, histogram_sources=[src2])
+    for i in range(12):
+        src2.weight += 5  # constant 5 units/s, well under capacity
+        ap2.tick(now=float(i))
+    assert fake2.scale_ups == []
+
+
+# ------------------------------------- 3. brownout ladder order + recovery
+def pytest_brownout_sheds_in_ladder_order_and_recovers_in_reverse():
+    fake = _FakePilotRouter()
+    cfg = AutopilotConfig(
+        min_replicas=1,
+        max_replicas=1,  # pin the fleet: isolate the ladder arm
+        brownout_high=1.5,
+        brownout_low=0.5,
+        brownout_sustain=2,
+        ladder=(
+            "shed_class:ensemble",
+            "tighten_deadlines:0.5",
+            "shrink_queue:8",
+        ),
+        per_replica_inflight=4,
+    )
+    ap = _pilot(fake, cfg)
+    # Saturate: pressure 5.0 >= high. Every sustain window deepens ONE step,
+    # strictly in severity order, each level restating the full state.
+    fake.queue = 20
+    for i in range(6):
+        ap.tick(now=float(i))
+    assert fake.deg_calls == [
+        (("ensemble",), 1.0, None),
+        (("ensemble",), 0.5, None),
+        (("ensemble",), 0.5, 8),
+    ]
+    assert ap.ladder.level == 3
+    # The dead band holds the level: no calls while pressure is between the
+    # watermarks (queue 4 / capacity 4 = 1.0).
+    fake.queue = 4
+    for i in range(6, 12):
+        ap.tick(now=float(i))
+    assert len(fake.deg_calls) == 3
+    # Recovery walks back in EXACT reverse order under the same sustain.
+    fake.queue = 0
+    for i in range(12, 18):
+        ap.tick(now=float(i))
+    assert fake.deg_calls[3:] == [
+        (("ensemble",), 0.5, None),
+        (("ensemble",), 1.0, None),
+        ((), 1.0, None),
+    ]
+    assert ap.ladder.level == 0
+    counters = ap.metrics.read_counters(
+        "brownout_step_total", "brownout_recover_total"
+    )
+    assert counters["brownout_step_total"] == 3
+    assert counters["brownout_recover_total"] == 3
+    # Severity order is a hard parse-time contract, not a convention.
+    with pytest.raises(ValueError):
+        parse_ladder(["shrink_queue:8", "shed_class:ensemble"])
+
+
+# ------------------------------------------------- 4. tenant bulkheads
+def pytest_tenant_quota_isolates_noisy_tenant():
+    """A noisy tenant saturating its in-flight quota is shed with a
+    tenant-tagged 429 while a victim tenant's request still completes —
+    the noisy tenant cannot spend fleet capacity beyond its bulkhead."""
+    block = threading.Event()
+    busy = _StubReplica("busy", block=block)
+    free = _StubReplica("free")
+    router = Router([busy, free], autostart_health=False, jitter_seed=0)
+    bulk = TenantBulkheads(inflight_quota=2, retry_budget=4)
+    router.set_bulkheads(bulk)
+    try:
+        from hydragnn_tpu.route import HashRing
+
+        ring = HashRing(64)
+        ring.add("busy")
+        ring.add("free")
+
+        def rid_for(primary):
+            for i in range(10000):
+                rid = f"probe-{i}"
+                if ring.owners(rid)[0] == primary:
+                    return rid
+            raise AssertionError(primary)
+
+        # Two noisy requests pin the blocked replica and fill the quota.
+        errs = []
+
+        def noisy():
+            try:
+                router.predict(
+                    [object()], request_id=rid_for("busy"), tenant="noisy"
+                )
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=noisy, daemon=True) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for _ in range(300):
+            if bulk.inflight("noisy") == 2:
+                break
+            threading.Event().wait(0.01)
+        assert bulk.inflight("noisy") == 2
+
+        # The third noisy request is shed at the bulkhead, tenant-tagged.
+        with pytest.raises(TenantQuotaError) as e:
+            router.predict(
+                [object()], request_id=rid_for("busy"), tenant="noisy"
+            )
+        assert e.value.tenant == "noisy"
+        assert e.value.retry_after_s > 0
+
+        # The victim tenant sails through on the free replica: its quota is
+        # untouched and the fleet still has capacity.
+        res = router.predict(
+            [object()], request_id=rid_for("free"), tenant="victim"
+        )
+        assert res.replica == "free"
+        assert bulk.inflight("victim") == 0  # released after completion
+
+        # Shed accounting: the bulkhead names the tenant, the router counts
+        # the shed in its own family.
+        assert bulk.metrics.snapshot()["per_tenant"]["noisy"]["shed"] == 1
+        shed = router.metrics.read_counters("shed_total")["shed_total"]
+        assert shed >= 1
+
+        block.set()
+        for t in threads:
+            t.join(10)
+        assert errs == []
+        # Slots released: the noisy tenant is admitted again.
+        res = router.predict(
+            [object()], request_id=rid_for("busy"), tenant="noisy"
+        )
+        assert res.replica == "busy"
+    finally:
+        block.set()
+        router.close()
+
+    # Retry-budget token bucket (deterministic injected clock): budget 2,
+    # no refill -> two retries pass, the third is denied; refill restores.
+    bulk2 = TenantBulkheads(
+        inflight_quota=4, retry_budget=2, retry_refill_per_s=1.0
+    )
+    assert bulk2.allow_retry("t", now=0.0)
+    assert bulk2.allow_retry("t", now=0.0)
+    assert not bulk2.allow_retry("t", now=0.0)
+    assert bulk2.allow_retry("t", now=1.5)  # 1.5 tokens refilled
+    assert bulk2.metrics.snapshot()["tenant_retry_denied_total"] == 1
+
+
+# ------------------------------------- 5. scale-to-zero + warm cold wake
+def pytest_scale_to_zero_then_cold_wake_hydrates_with_zero_compiles(
+    tmp_path,
+):
+    """Sustained idle retires the whole fleet (min_replicas=0); the first
+    failed request is the wake signal, and the woken replica hydrates its
+    ladder from the shared graftcache store — the compile spy must read 0."""
+    import __graft_entry__ as ge
+    from hydragnn_tpu.analysis.sentinel import compile_count
+    from hydragnn_tpu.graphs import collate_graphs
+    from hydragnn_tpu.graphs.collate import compute_pad_sizes
+    from hydragnn_tpu.models import init_model_variables
+    from hydragnn_tpu.route import InProcessReplica, NoReplicaAvailableError
+    from hydragnn_tpu.serve import InferenceEngine
+
+    rng = np.random.default_rng(3)
+    graphs = ge._make_graphs(6, rng)
+    model = ge._build_model(hidden=4, layers=1)
+    batch = collate_graphs(graphs[:2], ge.TYPES, ge.DIMS, edge_dim=1)
+    variables = init_model_variables(model, batch)
+    n_pad, e_pad, _ = compute_pad_sizes(graphs, 4)
+    ladder = [(n_pad, e_pad)]
+    store = str(tmp_path / "graftcache")
+
+    def engine(warmup):
+        return InferenceEngine(
+            model,
+            variables,
+            max_batch_graphs=4,
+            max_delay_ms=5.0,
+            bucket_ladder=ladder,
+            compile_cache=store,
+            warmup=warmup,
+        )
+
+    eng_a = engine(warmup=True)  # compiles cold, persists the ladder
+    router = Router(
+        [InProcessReplica("eng-a", eng_a)],
+        autostart_health=False,
+        expected_rungs=len(ladder),
+        jitter_seed=0,
+    )
+    spawned = {}
+
+    def factory(name):
+        eng = engine(warmup=False)
+        c0 = compile_count()
+        eng.warmup()  # hydrates from the store
+        spawned["warmup_xla_compiles"] = compile_count() - c0
+        spawned["engine"] = eng
+        return InProcessReplica(name, eng)
+
+    cfg = AutopilotConfig(
+        min_replicas=0,
+        max_replicas=1,
+        idle_ticks_to_zero=2,
+        cooldown_s=0.5,
+        spinup_wall_s=0.1,
+        sustain_down=50,
+        predictive=False,
+    )
+    ap = Autopilot(router, factory, cfg)
+    try:
+        assert ap.target == 1
+        # Two idle ticks: the fleet scales to zero and the retired replica
+        # is reaped (quiet) in the same pass.
+        ap.tick(now=0.0)
+        ap.tick(now=1.0)
+        assert ap.target == 0
+        assert router.states() == {}
+        assert (
+            ap.metrics.read_counters("scale_to_zero_total")[
+                "scale_to_zero_total"
+            ]
+            == 1
+        )
+
+        # The first request against the empty fleet fails fast (503,
+        # retryable) — that failure IS the cold-wake signal.
+        with pytest.raises(NoReplicaAvailableError):
+            router.predict([graphs[0]], request_id="wake-1")
+        s = ap.tick(now=2.0)
+        assert "cold_wake" in s["actions"]
+
+        # The spawn runs on the router's spawner thread; wait for warming
+        # to land, then admit via the health poll.
+        for _ in range(600):
+            if "pilot-1" in router.states():
+                break
+            threading.Event().wait(0.05)
+        states = router.states()
+        assert "pilot-1" in states, states
+        for _ in range(600):
+            router.poll_health()
+            if router.states()["pilot-1"]["state"] == "admitted":
+                break
+            threading.Event().wait(0.05)
+        assert router.states()["pilot-1"]["state"] == "admitted"
+
+        # Warm wake: the ladder came from the shared store, zero compiles.
+        assert spawned["warmup_xla_compiles"] == 0
+        res = router.predict([graphs[0]], request_id="wake-2")
+        assert res.replica == "pilot-1"
+        assert (
+            ap.metrics.read_counters("cold_wake_total")["cold_wake_total"]
+            == 1
+        )
+    finally:
+        ap.stop()  # closes the reaped eng-a replica on this thread
+        router.close(close_replicas=True)
+        if "engine" in spawned:
+            spawned["engine"].close()
+
+
+# --------------------------------------- 6. kill a replica under autoscale
+def pytest_kill_under_autoscale_replaces_corpse_zero_lost():
+    """Killing a replica mid-flight must lose zero accepted requests (the
+    router retries onto survivors) and the autopilot must replace the
+    ejected corpse and reap it — without operator input."""
+    s0, s1 = _StubReplica("s0"), _StubReplica("s1")
+    router = Router([s0, s1], autostart_health=False, jitter_seed=0)
+    cfg = AutopilotConfig(
+        min_replicas=2,
+        max_replicas=3,
+        cooldown_s=0.5,
+        spinup_wall_s=0.1,
+        sustain_down=100,
+        eject_grace_ticks=2,
+        predictive=False,
+    )
+    ap = _pilot(router, cfg)
+    try:
+        assert ap.target == 2
+        outcomes = []
+        for i in range(10):
+            res = router.predict([object()], request_id=f"pre-{i}")
+            outcomes.append(res.replica)
+        assert set(outcomes) == {"s0", "s1"}
+
+        # Kill s0: dispatches fail (retried onto s1), health checks fail
+        # (the loop drains, then ejects).
+        s0.predict_exc = ReplicaDownError("drill: s0 killed")
+        s0.health_exc = RuntimeError("drill: s0 unreachable")
+        for i in range(10):
+            res = router.predict([object()], request_id=f"mid-{i}")
+            assert res.replica == "s1"  # zero lost: every request completes
+        for _ in range(8):
+            router.poll_health()
+        assert router.states()["s0"]["state"] == "ejected"
+
+        # The pilot replaces the corpse (target 2, live 1) and — after the
+        # grace window — reaps it from the table entirely.
+        ap.tick(now=0.0)
+        for _ in range(600):
+            if "pilot-1" in router.states():
+                break
+            threading.Event().wait(0.05)
+        for _ in range(600):
+            router.poll_health()
+            if router.states().get("pilot-1", {}).get("state") == "admitted":
+                break
+            threading.Event().wait(0.05)
+        assert router.states()["pilot-1"]["state"] == "admitted"
+        ap.tick(now=1.0)
+        ap.tick(now=2.0)  # eject_grace_ticks reached -> corpse reaped
+        assert "s0" not in router.states()
+        counters = ap.metrics.read_counters("replace_total", "reap_total")
+        assert counters["replace_total"] == 1
+        assert counters["reap_total"] >= 1
+
+        # Post-replacement traffic spans the survivor and the replacement.
+        post = set()
+        for i in range(10):
+            post.add(router.predict([object()], request_id=f"post-{i}").replica)
+        assert post <= {"s1", "pilot-1"} and "pilot-1" in post
+        assert ap.close_retired() >= 1  # the corpse is closed caller-side
+        assert s0.closed
+    finally:
+        ap.stop()
+        router.close()
